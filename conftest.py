@@ -1,0 +1,23 @@
+"""Root conftest: force an 8-virtual-device CPU platform BEFORE any test
+touches jax.
+
+This is the framework's "fake cluster" (SURVEY.md §4): the analog of the
+reference's single-machine multi-process emulation (`scripts/local.sh`)
+is a single-process 8-device CPU mesh. TPU execution is exercised by
+bench.py / __graft_entry__.py outside pytest.
+"""
+
+import os
+
+# belt: env for subprocesses spawned by tests
+os.environ["JAX_PLATFORMS"] = os.environ.get("XFLOW_TEST_PLATFORM", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# suspenders: the ambient site config can override JAX_PLATFORMS (this
+# image pins an 'axon' TPU plugin), so pin the jax config directly too
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,92 @@
+"""Pure-NumPy oracles re-deriving the reference math for parity tests.
+
+These intentionally re-implement, from the surveyed equations
+(SURVEY.md §2 C3/C4/C11/C12), the same math as the JAX code — written
+against plain dicts/loops so a bug in the framework's vectorization
+can't hide in the oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class FTRLOracle:
+    """Per-key FTRL-proximal state machine (ftrl.h:58-74 semantics)."""
+
+    def __init__(self, dim=(), alpha=5e-2, beta=1.0, lambda1=5e-5, lambda2=10.0):
+        self.dim, self.alpha, self.beta = dim, alpha, beta
+        self.lambda1, self.lambda2 = lambda1, lambda2
+        self.store: dict = {}
+
+    def _entry(self, key):
+        if key not in self.store:
+            z = np.zeros(self.dim) if self.dim else 0.0
+            self.store[key] = {"w": np.copy(z), "n": np.copy(z), "z": np.copy(z)}
+        return self.store[key]
+
+    def push(self, key, g):
+        e = self._entry(key)
+        g = np.asarray(g, dtype=np.float64) if self.dim else float(g)
+        old_n = e["n"]
+        n = old_n + g * g
+        e["z"] = e["z"] + g - (np.sqrt(n) - np.sqrt(old_n)) / self.alpha * e["w"]
+        e["n"] = n
+        z = e["z"]
+        shrink = np.sign(z) * self.lambda1
+        denom = (self.beta + np.sqrt(n)) / self.alpha + self.lambda2
+        e["w"] = np.where(np.abs(z) <= self.lambda1, 0.0, -(z - shrink) / denom)
+
+    def pull(self, key):
+        return self._entry(key)["w"]
+
+
+def lr_forward_oracle(w_table, rows):
+    """rows: list of list-of-slot-ids. Returns logits."""
+    return np.array([sum(w_table[s] for s in row) for row in rows])
+
+
+def fm_forward_oracle(w_table, v_table, rows, half=True):
+    """Standard FM: wx + (1/2)Σ_k[(Σ_i v)^2 − Σ_i v^2]."""
+    out = []
+    for row in rows:
+        wx = sum(w_table[s] for s in row)
+        V = np.stack([v_table[s] for s in row])  # [nnz, k]
+        s = V.sum(axis=0)
+        q = (V * V).sum(axis=0)
+        second = (s * s - q).sum()
+        if half:
+            second *= 0.5
+        out.append(wx + second)
+    return np.array(out)
+
+
+def fm_forward_reference_coupled_oracle(w_table, v_table, rows):
+    """The reference's accidental cross-k form (fm_worker.cc:178-196)."""
+    out = []
+    for row in rows:
+        wx = sum(w_table[s] for s in row)
+        V = np.stack([v_table[s] for s in row])
+        S = V.sum()
+        Q = (V * V).sum()
+        out.append(wx + S * S - Q)
+    return np.array(out)
+
+
+def mvm_forward_oracle(v_table, rows_slots, rows_fields, num_fields):
+    """Π over present fields of per-field v sums, summed over k."""
+    out = []
+    for slots, fields in zip(rows_slots, rows_fields):
+        V = np.stack([v_table[s] for s in slots])  # [nnz, k]
+        k = V.shape[1]
+        prod = np.ones(k)
+        for f in range(num_fields):
+            sel = [i for i, fg in enumerate(fields) if fg == f]
+            if sel:
+                prod = prod * V[sel].sum(axis=0)
+        out.append(prod.sum())
+    return np.array(out)
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))

@@ -1,0 +1,62 @@
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from xflow_tpu.data.synth import generate_shards
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "xflow_tpu", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=env,
+        timeout=600,
+    )
+
+
+def test_gen_data_and_train_cli(tmp_path):
+    r = run_cli(["gen-data", str(tmp_path / "train"), "--shards", "1", "--rows", "400",
+                 "--fields", "5", "--ids-per-field", "30"], tmp_path)
+    assert r.returncode == 0, r.stderr
+    generate_shards(str(tmp_path / "test"), 1, 150, num_fields=5, ids_per_field=30, seed=9, truth_seed=0)
+    r = run_cli(
+        [
+            "train",
+            "--train", str(tmp_path / "train"),
+            "--test", str(tmp_path / "test"),
+            "--model", "lr",
+            "--epochs", "4",
+            "--batch-size", "64",
+            "--log2-slots", "12",
+            "--no-mesh",
+            "--set", "model.num_fields=5",
+        ],
+        tmp_path,
+    )
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["epochs"] == 4
+    assert summary["auc"] > 0.75
+    assert (tmp_path / "pred_0_0.txt").exists()
+
+
+def test_reference_model_index_accepted(tmp_path):
+    generate_shards(str(tmp_path / "train"), 1, 100, num_fields=4, ids_per_field=20)
+    r = run_cli(
+        ["train", "--train", str(tmp_path / "train"), "--model", "0", "--epochs", "1",
+         "--batch-size", "32", "--log2-slots", "10", "--no-mesh",
+         "--set", "model.num_fields=4"],
+        tmp_path,
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1])["steps"] == 4

@@ -1,0 +1,40 @@
+import numpy as np
+
+from xflow_tpu.hashing import FNV_OFFSET, fnv1a64, hash_token, slot_of, slots_of
+
+
+def test_fnv1a64_known_vectors():
+    # canonical FNV-1a 64 test vectors (salt 0)
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+
+
+def test_salt_changes_hash():
+    assert fnv1a64(b"1163", salt=0) != fnv1a64(b"1163", salt=1)
+
+
+def test_hash_token_matches_bytes():
+    assert hash_token("1163") == fnv1a64(b"1163")
+
+
+def test_slot_range_and_determinism():
+    for log2 in (4, 18, 22, 30, 33):
+        s = slot_of(fnv1a64(b"9999"), log2)
+        assert 0 <= s < (1 << log2)
+        assert s == slot_of(fnv1a64(b"9999"), log2)
+
+
+def test_slots_of_vectorized_matches_scalar():
+    keys = np.array([fnv1a64(str(i).encode()) for i in range(1000)], dtype=np.uint64)
+    vec = slots_of(keys, 18)
+    for i in range(1000):
+        assert vec[i] == slot_of(int(keys[i]), 18)
+
+
+def test_slot_distribution_roughly_uniform():
+    keys = np.array([fnv1a64(str(i).encode()) for i in range(20000)], dtype=np.uint64)
+    s = slots_of(keys, 6)  # 64 buckets
+    counts = np.bincount(s, minlength=64)
+    assert counts.min() > 0.5 * counts.mean()
+    assert counts.max() < 1.5 * counts.mean()

@@ -1,0 +1,71 @@
+import jax.numpy as jnp
+import numpy as np
+
+from xflow_tpu.metrics import BucketAUC, auc_logloss, binary_logloss_from_logits, reference_pctr
+
+
+def test_auc_perfect_and_inverted():
+    labels = np.array([1, 1, 0, 0])
+    auc, _ = auc_logloss(np.array([0.9, 0.8, 0.2, 0.1]), labels)
+    assert auc == 1.0
+    auc, _ = auc_logloss(np.array([0.1, 0.2, 0.8, 0.9]), labels)
+    assert auc == 0.0
+
+
+def test_auc_known_value():
+    # pairs: (0.8,1),(0.6,0),(0.4,1),(0.2,0) → 3 of 4 pos-neg pairs ranked right
+    auc, _ = auc_logloss(np.array([0.8, 0.6, 0.4, 0.2]), np.array([1, 0, 1, 0]))
+    assert abs(auc - 0.75) < 1e-9
+
+
+def test_auc_single_class_is_nan():
+    auc, _ = auc_logloss(np.array([0.5, 0.6]), np.array([1, 1]))
+    assert np.isnan(auc)
+
+
+def test_logloss_natural_and_log2():
+    p = np.array([0.5, 0.5])
+    y = np.array([1, 0])
+    _, ll = auc_logloss(p, y)
+    assert abs(ll - np.log(0.5)) < 1e-12
+    _, ll2 = auc_logloss(p, y, log2=True)
+    assert abs(ll2 - (-1.0)) < 1e-12
+
+
+def test_bucket_auc_approximates_exact():
+    rng = np.random.default_rng(0)
+    n = 5000
+    labels = (rng.random(n) < 0.3).astype(np.float32)
+    # informative scores
+    scores = np.clip(0.3 * labels + 0.4 * rng.random(n), 0, 1).astype(np.float32)
+    exact, _ = auc_logloss(scores, labels)
+    st = BucketAUC.init(4096)
+    st = st.update(jnp.asarray(scores), jnp.asarray(labels))
+    assert abs(st.compute() - exact) < 5e-3
+
+
+def test_bucket_auc_mergeable():
+    rng = np.random.default_rng(1)
+    s1, l1 = rng.random(100).astype(np.float32), (rng.random(100) < 0.5).astype(np.float32)
+    s2, l2 = rng.random(100).astype(np.float32), (rng.random(100) < 0.5).astype(np.float32)
+    joint = BucketAUC.init(512).update(jnp.asarray(np.concatenate([s1, s2])), jnp.asarray(np.concatenate([l1, l2])))
+    a = BucketAUC.init(512).update(jnp.asarray(s1), jnp.asarray(l1))
+    b = BucketAUC.init(512).update(jnp.asarray(s2), jnp.asarray(l2))
+    merged = BucketAUC(pos=a.pos + b.pos, neg=a.neg + b.neg)
+    assert abs(joint.compute() - merged.compute()) < 1e-9
+
+
+def test_reference_pctr_clamps():
+    p = np.asarray(reference_pctr(jnp.asarray([-100.0, 0.0, 100.0])))
+    assert p[0] == np.float32(1e-6)  # base.h:55-56
+    assert abs(p[1] - 0.5) < 1e-7
+    assert p[2] == 1.0  # base.h:57-58
+
+
+def test_bce_matches_naive():
+    logits = jnp.asarray([-2.0, 0.0, 3.0])
+    labels = jnp.asarray([0.0, 1.0, 1.0])
+    got = np.asarray(binary_logloss_from_logits(logits, labels))
+    p = 1 / (1 + np.exp(-np.asarray(logits)))
+    want = -(np.asarray(labels) * np.log(p) + (1 - np.asarray(labels)) * np.log(1 - p))
+    np.testing.assert_allclose(got, want, rtol=1e-4)

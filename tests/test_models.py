@@ -1,0 +1,129 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.oracles import (
+    fm_forward_oracle,
+    fm_forward_reference_coupled_oracle,
+    lr_forward_oracle,
+    mvm_forward_oracle,
+)
+from xflow_tpu.config import Config, override
+from xflow_tpu.models import get_model
+from xflow_tpu.models.base import init_tables
+
+LOG2 = 10  # 1024 slots — tiny for tests
+NF = 4
+
+
+def small_cfg(**kw):
+    cfg = override(
+        Config(),
+        **{"data.log2_slots": LOG2, "model.v_dim": 3, "model.num_fields": NF},
+    )
+    return override(cfg, **kw) if kw else cfg
+
+
+def make_batch_arrays(rows_slots, rows_fields, labels, max_nnz=8):
+    B = len(labels)
+    slots = np.zeros((B, max_nnz), np.int32)
+    fields = np.zeros((B, max_nnz), np.int32)
+    mask = np.zeros((B, max_nnz), np.float32)
+    for i, (ss, ff) in enumerate(zip(rows_slots, rows_fields)):
+        slots[i, : len(ss)] = ss
+        fields[i, : len(ff)] = ff
+        mask[i, : len(ss)] = 1.0
+    return {
+        "slots": jnp.asarray(slots),
+        "fields": jnp.asarray(fields),
+        "mask": jnp.asarray(mask),
+        "labels": jnp.asarray(np.asarray(labels, np.float32)),
+        "row_mask": jnp.ones((B,), jnp.float32),
+    }
+
+
+ROWS_SLOTS = [[1, 5, 9], [2, 5], [7, 7, 3, 1]]  # note duplicate slot in row 2
+ROWS_FIELDS = [[0, 1, 2], [0, 3], [1, 1, 2, 0]]
+LABELS = [1.0, 0.0, 1.0]
+
+
+def test_lr_forward_matches_oracle():
+    cfg = small_cfg()
+    model = get_model("lr")
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(1 << LOG2,)).astype(np.float32)
+    batch = make_batch_arrays(ROWS_SLOTS, ROWS_FIELDS, LABELS)
+    got = model.forward({"w": jnp.asarray(w)}, batch, cfg)
+    want = lr_forward_oracle(w, ROWS_SLOTS)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("half", [True, False])
+def test_fm_forward_matches_oracle(half):
+    cfg = small_cfg(**{"model.fm_half": half})
+    model = get_model("fm")
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(1 << LOG2,)).astype(np.float32)
+    v = rng.normal(size=(1 << LOG2, 3)).astype(np.float32) * 0.1
+    batch = make_batch_arrays(ROWS_SLOTS, ROWS_FIELDS, LABELS)
+    got = model.forward({"w": jnp.asarray(w), "v": jnp.asarray(v)}, batch, cfg)
+    want = fm_forward_oracle(w, v, ROWS_SLOTS, half=half)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_fm_reference_coupled_mode():
+    cfg = small_cfg(**{"model.fm_standard": False})
+    model = get_model("fm")
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(1 << LOG2,)).astype(np.float32)
+    v = rng.normal(size=(1 << LOG2, 3)).astype(np.float32) * 0.1
+    batch = make_batch_arrays(ROWS_SLOTS, ROWS_FIELDS, LABELS)
+    got = model.forward({"w": jnp.asarray(w), "v": jnp.asarray(v)}, batch, cfg)
+    want = fm_forward_reference_coupled_oracle(w, v, ROWS_SLOTS)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_mvm_forward_matches_oracle():
+    cfg = small_cfg()
+    model = get_model("mvm")
+    rng = np.random.default_rng(3)
+    v = rng.normal(size=(1 << LOG2, 3)).astype(np.float32) * 0.5
+    batch = make_batch_arrays(ROWS_SLOTS, ROWS_FIELDS, LABELS)
+    got = model.forward({"v": jnp.asarray(v)}, batch, cfg)
+    want = mvm_forward_oracle(v, ROWS_SLOTS, ROWS_FIELDS, NF)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_mvm_absent_field_is_identity():
+    # a row using only field 0 must not be zeroed by absent fields
+    cfg = small_cfg()
+    model = get_model("mvm")
+    v = np.zeros((1 << LOG2, 3), np.float32)
+    v[5] = [2.0, 3.0, 4.0]
+    batch = make_batch_arrays([[5]], [[0]], [1.0])
+    got = np.asarray(model.forward({"v": jnp.asarray(v)}, batch, cfg))
+    assert got[0] == pytest.approx(2.0 + 3.0 + 4.0)
+
+
+def test_init_tables_shapes_and_init():
+    cfg = small_cfg()
+    key = jax.random.PRNGKey(0)
+    t_fm = init_tables(get_model("fm"), cfg, key)
+    assert t_fm["w"].shape == (1 << LOG2,)
+    assert t_fm["v"].shape == (1 << LOG2, 3)
+    assert float(jnp.abs(t_fm["w"]).max()) == 0.0  # w starts at 0 (ftrl.h:27-36)
+    assert 0 < float(jnp.abs(t_fm["v"]).mean()) < 0.1  # ~N(0,1)*1e-2 (ftrl.h:117)
+    cfg_sgd = small_cfg(**{"optim.name": "sgd"})
+    t_sgd = init_tables(get_model("fm"), cfg_sgd, key)
+    np.testing.assert_allclose(np.asarray(t_sgd["v"]), 1e-3)  # sgd.h:69
+
+
+def test_padded_row_gives_zero_logit_lr():
+    cfg = small_cfg()
+    model = get_model("lr")
+    w = jnp.ones((1 << LOG2,))
+    batch = make_batch_arrays([[1, 2]], [[0, 1]], [1.0], max_nnz=4)
+    batch["mask"] = batch["mask"].at[0, :].set(0.0)
+    got = model.forward({"w": w}, batch, cfg)
+    assert float(got[0]) == 0.0

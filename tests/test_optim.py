@@ -1,0 +1,78 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tests.oracles import FTRLOracle
+from xflow_tpu.config import Config, override
+from xflow_tpu.optim import get_optimizer
+
+CFG = override(Config(), **{"data.log2_slots": 6})
+N = 64
+
+
+def test_ftrl_matches_per_key_oracle_over_steps():
+    opt = get_optimizer("ftrl")
+    tables = {"w": jnp.zeros((N,), jnp.float32)}
+    state = opt.init_state(tables)
+    oracle = FTRLOracle()
+    rng = np.random.default_rng(0)
+    for step in range(20):
+        g = np.zeros((N,), np.float32)
+        touched = rng.choice(N, size=10, replace=False)
+        g[touched] = rng.normal(size=10).astype(np.float32)
+        tables, state = opt.apply(tables, state, {"w": jnp.asarray(g)}, CFG)
+        for k in touched:
+            oracle.push(int(k), float(g[k]))
+    w = np.asarray(tables["w"], np.float64)
+    for k in range(N):
+        np.testing.assert_allclose(w[k], oracle.pull(k), rtol=1e-4, atol=1e-6)
+
+
+def test_ftrl_zero_gradient_is_noop():
+    opt = get_optimizer("ftrl")
+    rng = np.random.default_rng(1)
+    tables = {"w": jnp.asarray(rng.normal(size=(N,)).astype(np.float32))}
+    state = opt.init_state(tables)
+    # one real update to move n/z off zero, then a zero push
+    g1 = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    tables, state = opt.apply(tables, state, {"w": g1}, CFG)
+    t2, s2 = opt.apply(tables, state, {"w": jnp.zeros((N,))}, CFG)
+    np.testing.assert_allclose(np.asarray(t2["w"]), np.asarray(tables["w"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s2["w"]["n"]), np.asarray(state["w"]["n"]))
+    np.testing.assert_allclose(np.asarray(s2["w"]["z"]), np.asarray(state["w"]["z"]))
+
+
+def test_ftrl_sparsity():
+    # tiny gradients must leave w exactly 0 (soft threshold λ1)
+    opt = get_optimizer("ftrl")
+    tables = {"w": jnp.zeros((4,), jnp.float32)}
+    state = opt.init_state(tables)
+    tables, state = opt.apply(tables, state, {"w": jnp.full((4,), 1e-6)}, CFG)
+    assert float(jnp.abs(tables["w"]).max()) == 0.0
+
+
+def test_ftrl_vector_table():
+    opt = get_optimizer("ftrl")
+    tables = {"v": jnp.zeros((8, 3), jnp.float32)}
+    state = opt.init_state(tables)
+    oracle = FTRLOracle(dim=(3,))
+    rng = np.random.default_rng(2)
+    for _ in range(5):
+        g = rng.normal(size=(8, 3)).astype(np.float32)
+        tables, state = opt.apply(tables, state, {"v": jnp.asarray(g)}, CFG)
+        for k in range(8):
+            oracle.push(k, g[k])
+    for k in range(8):
+        np.testing.assert_allclose(
+            np.asarray(tables["v"][k], np.float64), oracle.pull(k), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_sgd_update():
+    opt = get_optimizer("sgd")
+    tables = {"w": jnp.ones((4,), jnp.float32)}
+    state = opt.init_state(tables)
+    g = jnp.asarray([1.0, -1.0, 0.0, 2.0])
+    tables, state = opt.apply(tables, state, {"w": g}, CFG)
+    np.testing.assert_allclose(
+        np.asarray(tables["w"]), [1 - 1e-3, 1 + 1e-3, 1.0, 1 - 2e-3], rtol=1e-6
+    )

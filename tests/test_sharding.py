@@ -1,0 +1,126 @@
+"""Sharded-vs-single-device parity on the virtual 8-CPU-device mesh.
+
+SURVEY.md §7 phase 3 gate: same step function, sharding specs only —
+metrics must match the single-device run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.models import get_model
+from xflow_tpu.optim import get_optimizer
+from xflow_tpu.parallel.mesh import make_mesh, batch_sharding
+from xflow_tpu.parallel.train_step import (
+    make_sharded_eval_step,
+    make_sharded_train_step,
+    shard_state,
+)
+from xflow_tpu.train import init_state, make_eval_step, make_train_step
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual CPU devices"
+)
+
+
+def cfg_for(model="lr", d=4, t=2, **kw):
+    base = {
+        "data.log2_slots": 12,
+        "model.name": model,
+        "model.num_fields": 5,
+        "model.v_dim": 4,
+        "mesh.data": d,
+        "mesh.table": t,
+    }
+    base.update(kw)
+    return override(Config(), **base)
+
+
+def rand_batch(rng, B=64, F=10, num_slots=1 << 12, nf=5):
+    slots = rng.integers(0, num_slots, (B, F)).astype(np.int32)
+    fields = rng.integers(0, nf, (B, F)).astype(np.int32)
+    mask = (rng.random((B, F)) < 0.8).astype(np.float32)
+    labels = (rng.random(B) < 0.4).astype(np.float32)
+    return {
+        "slots": slots,
+        "fields": fields,
+        "mask": mask,
+        "labels": labels,
+        "row_mask": np.ones((B,), np.float32),
+    }
+
+
+@pytest.mark.parametrize("model_name", ["lr", "fm", "mvm"])
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_step_matches_single_device(model_name, mesh_shape):
+    d, t = mesh_shape
+    cfg = cfg_for(model_name, d, t)
+    model, opt = get_model(model_name), get_optimizer("ftrl")
+    rng = np.random.default_rng(0)
+    batches = [rand_batch(rng) for _ in range(3)]
+
+    # single-device run
+    state1 = init_state(model, opt, cfg)
+    step1 = make_train_step(model, opt, cfg)
+    losses1 = []
+    for b in batches:
+        state1, m = step1(state1, {k: jnp.asarray(v) for k, v in b.items()})
+        losses1.append(float(m["loss"]))
+
+    # sharded run
+    mesh = make_mesh(cfg)
+    state2 = shard_state(init_state(model, opt, cfg), mesh)
+    step2 = make_sharded_train_step(model, opt, cfg, mesh)
+    bsh = batch_sharding(mesh)
+    losses2 = []
+    for b in batches:
+        placed = {k: jax.device_put(jnp.asarray(v), bsh[k]) for k, v in b.items()}
+        state2, m = step2(state2, placed)
+        losses2.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses1, losses2, rtol=2e-5)
+    for name in state1.tables:
+        np.testing.assert_allclose(
+            np.asarray(state1.tables[name]),
+            np.asarray(state2.tables[name]),
+            rtol=2e-4,
+            atol=1e-6,
+        )
+
+
+def test_sharded_eval_matches_single_device():
+    cfg = cfg_for("fm", 4, 2)
+    model = get_model("fm")
+    opt = get_optimizer("ftrl")
+    rng = np.random.default_rng(1)
+    b = rand_batch(rng)
+    state = init_state(model, opt, cfg)
+    p1 = np.asarray(
+        make_eval_step(model, cfg)(state.tables, {k: jnp.asarray(v) for k, v in b.items()})
+    )
+    mesh = make_mesh(cfg)
+    sstate = shard_state(state, mesh)
+    bsh = batch_sharding(mesh)
+    placed = {k: jax.device_put(jnp.asarray(v), bsh[k]) for k, v in b.items()}
+    p2 = np.asarray(make_sharded_eval_step(model, cfg, mesh)(sstate.tables, placed))
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-7)
+
+
+def test_table_actually_sharded():
+    cfg = cfg_for("lr", 4, 2)
+    mesh = make_mesh(cfg)
+    model, opt = get_model("lr"), get_optimizer("ftrl")
+    state = shard_state(init_state(model, opt, cfg), mesh)
+    w = state.tables["w"]
+    # each of the 8 devices holds 1/8 of the slot axis
+    shard_shapes = {s.data.shape for s in w.addressable_shards}
+    assert shard_shapes == {((1 << 12) // 8,)}
+
+
+def test_mesh_inference():
+    cfg = override(Config(), **{"mesh.data": -1, "mesh.table": 2})
+    mesh = make_mesh(cfg)
+    assert mesh.shape["data"] == len(jax.devices()) // 2
+    assert mesh.shape["table"] == 2

@@ -1,0 +1,150 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.data.pipeline import examples_to_batches
+from xflow_tpu.data.synth import generate_shards
+from xflow_tpu.data.libffm import iter_examples
+from xflow_tpu.metrics import auc_logloss
+from xflow_tpu.models import get_model
+from xflow_tpu.optim import get_optimizer
+from xflow_tpu.train import init_state, make_eval_step, make_train_step
+from xflow_tpu.train.step import batch_to_arrays
+
+
+def small_cfg(**kw):
+    base = {
+        "data.log2_slots": 14,
+        "data.batch_size": 64,
+        "data.max_nnz": 20,
+        "model.num_fields": 6,
+        "model.v_dim": 4,
+    }
+    base.update(kw)
+    return override(Config(), **base)
+
+
+def _device_batches(path, cfg):
+    return [
+        {k: jnp.asarray(v) for k, v in batch_to_arrays(b).items()}
+        for b in examples_to_batches(
+            iter_examples(path, cfg.data.log2_slots), cfg.data.batch_size, cfg.data.max_nnz
+        )
+    ]
+
+
+def test_lr_gradient_is_scatter_of_residuals():
+    # hand-check: grad wrt w[slot] == sum over occurrences (σ(wx)−y)/rows
+    cfg = small_cfg()
+    model = get_model("lr")
+    from xflow_tpu.train.step import loss_fn
+
+    w = jnp.zeros((cfg.num_slots,))
+    batch = {
+        "slots": jnp.asarray([[3, 5, 0], [3, 3, 0]], jnp.int32),
+        "fields": jnp.zeros((2, 3), jnp.int32),
+        "mask": jnp.asarray([[1, 1, 0], [1, 1, 0]], jnp.float32),
+        "labels": jnp.asarray([1.0, 0.0]),
+        "row_mask": jnp.ones((2,)),
+    }
+    g = jax.grad(loss_fn)(({"w": w}), batch, model, cfg)["w"]
+    # logits 0 → σ=0.5; residuals: row0 = −0.5 on slots {3,5}, row1 = +0.5 twice on slot 3
+    np.testing.assert_allclose(float(g[3]), (-0.5 + 0.5 + 0.5) / 2, rtol=1e-6)
+    np.testing.assert_allclose(float(g[5]), -0.5 / 2, rtol=1e-6)
+    assert float(g[0]) == 0.0  # masked padding contributes nothing
+
+
+def test_training_learns_synthetic_lr(tmp_path):
+    cfg = small_cfg()
+    path = generate_shards(str(tmp_path / "s"), 1, 2000, num_fields=6, ids_per_field=50, seed=0, noise=0.3)[0]
+    model, opt = get_model("lr"), get_optimizer("ftrl")
+    state = init_state(model, opt, cfg)
+    step = make_train_step(model, opt, cfg)
+    eval_step = make_eval_step(model, cfg)
+    batches = _device_batches(path, cfg)
+    for epoch in range(8):
+        for b in batches:
+            state, m = step(state, b)
+    pctrs, labels = [], []
+    for b in batches:
+        p = np.asarray(eval_step(state.tables, b))
+        rm = np.asarray(b["row_mask"]) > 0
+        pctrs.append(p[rm])
+        labels.append(np.asarray(b["labels"])[rm])
+    auc, ll = auc_logloss(np.concatenate(pctrs), np.concatenate(labels))
+    assert auc > 0.85, f"LR failed to learn synthetic data: auc={auc}"
+
+
+def test_training_learns_fm(tmp_path):
+    path = generate_shards(str(tmp_path / "s"), 1, 1500, num_fields=6, ids_per_field=50, seed=1, noise=0.3)[0]
+    cfg = override(small_cfg(), **{"model.name": "fm"})
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+    state = init_state(model, opt, cfg)
+    step = make_train_step(model, opt, cfg)
+    eval_step = make_eval_step(model, cfg)
+    batches = _device_batches(path, cfg)
+    for epoch in range(10):
+        for b in batches:
+            state, m = step(state, b)
+    pctrs, labels = [], []
+    for b in batches:
+        p = np.asarray(eval_step(state.tables, b))
+        rm = np.asarray(b["row_mask"]) > 0
+        pctrs.append(p[rm])
+        labels.append(np.asarray(b["labels"])[rm])
+    auc, _ = auc_logloss(np.concatenate(pctrs), np.concatenate(labels))
+    assert auc > 0.8, f"fm failed to learn: auc={auc}"
+
+
+def test_mvm_trains_loss_decreases(tmp_path):
+    # MVM has no linear term: its logit is a product over field sums, so a
+    # planted-LR task isn't representable near tiny init, and FTRL's soft
+    # threshold zeroes the tiny latent weights outright (true of the
+    # reference too). Assert steady SGD progress instead.
+    path = generate_shards(str(tmp_path / "s"), 1, 512, num_fields=3, ids_per_field=20, seed=2, noise=0.3)[0]
+    cfg = override(
+        small_cfg(),
+        **{
+            "model.name": "mvm",
+            "model.num_fields": 3,
+            "optim.name": "sgd",
+            "optim.sgd.lr": 1.0,
+            "optim.v_init_sgd": 0.3,
+        },
+    )
+    model, opt = get_model("mvm"), get_optimizer("sgd")
+    state = init_state(model, opt, cfg)
+    step = make_train_step(model, opt, cfg)
+    batches = _device_batches(path, cfg)
+    first = last = None
+    for epoch in range(15):
+        tot, n = 0.0, 0
+        for b in batches:
+            state, m = step(state, b)
+            tot += float(m["loss"]); n += 1
+        if first is None:
+            first = tot / n
+        last = tot / n
+    assert last < first * 0.95, f"mvm loss did not decrease: {first} -> {last}"
+
+
+def test_loss_decreases():
+    cfg = small_cfg()
+    rng = np.random.default_rng(0)
+    model, opt = get_model("lr"), get_optimizer("sgd")
+    cfg = override(cfg, **{"optim.name": "sgd", "optim.sgd.lr": 0.5})
+    state = init_state(model, opt, cfg)
+    step = make_train_step(model, opt, cfg)
+    batch = {
+        "slots": jnp.asarray(rng.integers(0, cfg.num_slots, (32, 8)), jnp.int32),
+        "fields": jnp.zeros((32, 8), jnp.int32),
+        "mask": jnp.ones((32, 8), jnp.float32),
+        "labels": jnp.asarray((rng.random(32) < 0.5).astype(np.float32)),
+        "row_mask": jnp.ones((32,)),
+    }
+    losses = []
+    for _ in range(20):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8

@@ -1,0 +1,25 @@
+"""xflow-tpu: a TPU-native sparse CTR training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of pandadady/xflow
+(reference surveyed in SURVEY.md): distributed training of sparse
+logistic regression, factorization machines, and multi-view machines
+over hashed libffm features, with server-side-equivalent FTRL-proximal
+and SGD optimizers.
+
+Where the reference runs an asynchronous parameter server (ps-lite over
+ZeroMQ; scheduler/server/worker roles, sparse KV Push/Pull), this
+framework is synchronous SPMD over a `jax.sharding.Mesh`:
+
+- the parameter "tables" (reference: `std::unordered_map<ps::Key, Entry>`
+  on server processes, `/root/reference/src/optimizer/ftrl.h:84`) are
+  dense ``[2**K]``-slot arrays sharded on the feature-hash axis;
+- Pull becomes a sharded gather (``table[slots]``), Push becomes the
+  scatter-add that `jax.grad` produces through that gather;
+- the optimizer update (reference: server request handler,
+  `/root/reference/src/optimizer/ftrl.h:38-85`) is a pure elementwise
+  XLA update over the dense state arrays, fused into the train step.
+"""
+
+from xflow_tpu.version import __version__
+
+__all__ = ["__version__"]

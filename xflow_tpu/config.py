@@ -1,0 +1,171 @@
+"""Configuration tree for xflow-tpu.
+
+The reference scatters its configuration across three primitive layers
+(SURVEY.md §5 "Config / flag system"): positional argv
+(`/root/reference/src/model/main.cc:16-45`), `DMLC_*` env vars for
+topology, and hard-coded constants (FTRL hyperparams
+`/root/reference/src/optimizer/ftrl.h:17-20`, SGD lr `sgd.h:16`, latent
+dim `ftrl.h:16`, IO block size `lr_worker.h:68`). Here everything lives
+in one dataclass tree with CLI/env overrides (see launch/cli.py).
+
+Defaults reproduce the reference's hard-coded values so that a default
+run is hyperparameter-equivalent to the reference's default run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class FTRLConfig:
+    """FTRL-proximal hyperparameters.
+
+    Defaults match `/root/reference/src/optimizer/ftrl.h:17-20`.
+    """
+
+    alpha: float = 5e-2
+    beta: float = 1.0
+    lambda1: float = 5e-5
+    lambda2: float = 10.0
+
+
+@dataclass(frozen=True)
+class SGDConfig:
+    """SGD hyperparameters. Default lr matches `/root/reference/src/optimizer/sgd.h:16`."""
+
+    lr: float = 1e-3
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer selection.
+
+    The reference selects the optimizer by editing
+    `/root/reference/src/model/server.h:24-29`; here it is config.
+    `v_init_scale` / `v_init_sgd` reproduce the lazy v-table inits
+    (`ftrl.h:117` ~N(0,1)*1e-2; `sgd.h:69` constant 1e-3).
+    """
+
+    name: str = "ftrl"  # "ftrl" | "sgd"
+    ftrl: FTRLConfig = field(default_factory=FTRLConfig)
+    sgd: SGDConfig = field(default_factory=SGDConfig)
+    v_init_scale: float = 1e-2
+    v_init_sgd: float = 1e-3
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model selection and dims.
+
+    `v_dim` default matches the reference latent dim
+    (`/root/reference/src/optimizer/ftrl.h:16`, `fm_worker.h:92`).
+    `num_fields` bounds the libffm field-group ids (bundled data uses 18,
+    fields 0..17). `fm_standard` selects the textbook FM second-order
+    term (per-latent-dim, with the 1/2 factor); the reference's FM
+    couples latent dims through a shared accumulator
+    (`/root/reference/src/model/fm/fm_worker.cc:178-196` sums v over all
+    k into one scalar per row) — an accident SURVEY.md §7 says to fix,
+    not replicate. Default is the standard form.
+    """
+
+    name: str = "lr"  # "lr" | "fm" | "mvm"
+    v_dim: int = 10
+    num_fields: int = 18
+    fm_standard: bool = True
+    fm_half: bool = True
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Input pipeline configuration.
+
+    `log2_slots` replaces the reference's unbounded 64-bit key space
+    (hash of the feature-id string, `load_data_from_disk.cc:151`, stored
+    sparsely in server hash maps) with a dense `2**log2_slots` table;
+    collisions are accepted, as in the reference, and measurable via
+    tools/collisions. `max_nnz` is the padded per-row feature capacity
+    (bundled data has ~18). `block_bytes` mirrors the reference reader's
+    block-buffered fread (`lr_worker.h:68` block_size=2 MiB).
+    """
+
+    train_path: str = ""
+    test_path: str = ""
+    batch_size: int = 1024
+    max_nnz: int = 32
+    log2_slots: int = 22
+    hash_salt: int = 0
+    block_bytes: int = 2 << 20
+    drop_remainder: bool = False  # reference drops remainder rows (lr_worker.cc:190); we pad instead
+    use_native_parser: bool = True  # C++ parser if built; falls back to Python
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh: ('data', 'table').
+
+    `data` is the analog of the reference's N worker processes
+    (file-sharded async data parallelism), `table` the analog of its N
+    key-range-sharded server processes (SURVEY.md §2 C13). -1 means
+    "infer from available devices".
+    """
+
+    data: int = -1
+    table: int = 1
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    epochs: int = 60  # reference default (lr_worker.h:63)
+    seed: int = 0
+    eval_every: int = 0  # 0 = eval only at end, like the reference
+    log_every: int = 100
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0  # steps; 0 = only at end if dir set
+    resume: bool = True
+    pred_dump: bool = True  # write pred_<rank>_<block>.txt like lr_worker.cc:74-78
+    metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
+    profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
+
+
+@dataclass(frozen=True)
+class Config:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    @property
+    def num_slots(self) -> int:
+        return 1 << self.data.log2_slots
+
+
+def _replace_nested(obj: Any, path: list[str], value: Any) -> Any:
+    if len(path) == 1:
+        fld = {f.name: f for f in dataclasses.fields(obj)}[path[0]]
+        typ = fld.type
+        cur = getattr(obj, path[0])
+        if isinstance(cur, bool):
+            if isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes", "on")
+        elif isinstance(cur, int):
+            value = int(value)
+        elif isinstance(cur, float):
+            value = float(value)
+        return dataclasses.replace(obj, **{path[0]: value})
+    child = getattr(obj, path[0])
+    return dataclasses.replace(obj, **{path[0]: _replace_nested(child, path[1:], value)})
+
+
+def override(cfg: Config, **dotted: Any) -> Config:
+    """Apply dotted-path overrides: override(cfg, **{"optim.name": "sgd"})."""
+    for key, value in dotted.items():
+        cfg = _replace_nested(cfg, key.split("."), value)
+    return cfg
+
+
+def from_overrides(pairs: dict[str, Any], base: Optional[Config] = None) -> Config:
+    return override(base or Config(), **pairs)

@@ -1,0 +1,11 @@
+from xflow_tpu.data.schema import SparseBatch
+from xflow_tpu.data.libffm import iter_examples, read_examples
+from xflow_tpu.data.pipeline import batch_iterator, examples_to_batches
+
+__all__ = [
+    "SparseBatch",
+    "iter_examples",
+    "read_examples",
+    "batch_iterator",
+    "examples_to_batches",
+]

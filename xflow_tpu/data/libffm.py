@@ -1,0 +1,95 @@
+"""libffm-format reader (pure-Python reference path).
+
+Format: ``label\\tfield:feature:value [field:feature:value ...]`` — one
+example per line (see `/root/reference/data/small_train-00000`).
+
+Semantics preserved from the reference parser
+(`/root/reference/src/io/load_data_from_disk.cc:103-210`):
+
+- the label token is parsed as a float; label = 1 iff > 1e-7
+  (`load_data_from_disk.cc:131-134`);
+- each feature token contributes ``(fgid, hash(feature_id_string))``;
+  the *value* field is never parsed (`:150-153` break after field 1) —
+  features are binary;
+- the feature id is hashed as a *string* (`:151`); we use the framework
+  hash (hashing.fnv1a64) instead of implementation-defined `std::hash`;
+- reading is block-buffered with partial-line carry (`:108-124`); the
+  Python path just streams lines (the C++ native parser keeps the
+  block-buffered design for throughput).
+
+The reference's per-rank shard convention ``"%s-%05d" % (prefix, rank)``
+(`lr_worker.cc:210`) is provided by `shard_path`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from xflow_tpu.hashing import fnv1a64, slot_of
+
+
+def shard_path(prefix: str, rank: int) -> str:
+    """Reference shard naming: `<prefix>-%05d` (`lr_worker.cc:210`)."""
+    return "%s-%05d" % (prefix, rank)
+
+
+def parse_line(
+    line: str, log2_slots: int, salt: int = 0
+) -> Optional[tuple[float, np.ndarray, np.ndarray]]:
+    """Parse one libffm line → (label, fields[int32], slots[int32])."""
+    line = line.strip()
+    if not line:
+        return None
+    parts = line.split("\t", 1)
+    if len(parts) == 1:
+        # tolerate space-separated label too
+        parts = line.split(" ", 1)
+        if len(parts) == 1:
+            return None
+    label = 1.0 if float(parts[0]) > 1e-7 else 0.0
+    fields = []
+    slots = []
+    for tok in parts[1].split():
+        pieces = tok.split(":")
+        if len(pieces) < 2:
+            continue
+        fields.append(int(float(pieces[0])))
+        slots.append(slot_of(fnv1a64(pieces[1].encode("utf-8"), salt), log2_slots))
+    return (
+        label,
+        np.asarray(fields, dtype=np.int32),
+        np.asarray(slots, dtype=np.int32),
+    )
+
+
+def iter_examples(
+    path: str, log2_slots: int, salt: int = 0
+) -> Iterator[tuple[float, np.ndarray, np.ndarray]]:
+    """Stream (label, fields, slots) examples from a libffm file."""
+    with open(path, "r") as f:
+        for line in f:
+            ex = parse_line(line, log2_slots, salt)
+            if ex is not None:
+                yield ex
+
+
+def read_examples(
+    path: str, log2_slots: int, salt: int = 0
+) -> list[tuple[float, np.ndarray, np.ndarray]]:
+    return list(iter_examples(path, log2_slots, salt))
+
+
+def available_shards(prefix: str) -> list[str]:
+    """All `<prefix>-NNNNN` shard files that exist, in rank order."""
+    out = []
+    rank = 0
+    while True:
+        p = shard_path(prefix, rank)
+        if not os.path.exists(p):
+            break
+        out.append(p)
+        rank += 1
+    return out

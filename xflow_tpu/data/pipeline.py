@@ -1,0 +1,98 @@
+"""Batching pipeline: libffm examples → padded SparseBatch stream.
+
+The reference couples its minibatch size to the IO block size (however
+many lines fit in a 2 MiB fread block, `lr_worker.cc:184-188`) and then
+silently drops remainder rows when the block doesn't divide by the
+thread count (`lr_worker.cc:190-194`). Here batches are a fixed
+``batch_size`` rows (static XLA shapes) and the final partial batch is
+padded and masked rather than dropped (configurable via
+``drop_remainder`` for strict reference emulation).
+
+`prefetch_to_device` overlaps host parsing with device compute — the
+TPU analog of the reference's double-duty IO/compute threads.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from xflow_tpu.config import DataConfig
+from xflow_tpu.data.schema import SparseBatch, make_batch
+from xflow_tpu.data.libffm import iter_examples
+
+
+def examples_to_batches(
+    examples: Iterable[tuple[float, np.ndarray, np.ndarray]],
+    batch_size: int,
+    max_nnz: int,
+    drop_remainder: bool = False,
+) -> Iterator[SparseBatch]:
+    labels: list[float] = []
+    fields: list[np.ndarray] = []
+    slots: list[np.ndarray] = []
+    for label, f, s in examples:
+        labels.append(label)
+        fields.append(f)
+        slots.append(s)
+        if len(labels) == batch_size:
+            yield make_batch(fields, slots, labels, batch_size, max_nnz)
+            labels, fields, slots = [], [], []
+    if labels and not drop_remainder:
+        yield make_batch(fields, slots, labels, batch_size, max_nnz)
+
+
+def batch_iterator(
+    path: str,
+    cfg: DataConfig,
+    batch_size: Optional[int] = None,
+) -> Iterator[SparseBatch]:
+    """Stream padded batches from a libffm file, preferring the native parser."""
+    bs = batch_size or cfg.batch_size
+    if cfg.use_native_parser:
+        native_iter = None
+        try:
+            # only import/construction is guarded: a failure mid-iteration
+            # must surface, not silently restart the file with the Python
+            # parser (which would duplicate already-yielded batches)
+            from xflow_tpu.data.native import native_batch_iterator
+
+            native_iter = native_batch_iterator(path, cfg, bs)
+        except (ImportError, OSError, RuntimeError):
+            native_iter = None
+        if native_iter is not None:
+            yield from native_iter
+            return
+    yield from examples_to_batches(
+        iter_examples(path, cfg.log2_slots, cfg.hash_salt),
+        bs,
+        cfg.max_nnz,
+        cfg.drop_remainder,
+    )
+
+
+def prefetch(iterator: Iterator[SparseBatch], depth: int = 2) -> Iterator[SparseBatch]:
+    """Run the parse/batch pipeline in a background thread with a bounded queue."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def worker() -> None:
+        try:
+            for item in iterator:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # re-raised in the consumer
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            break
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -1,0 +1,79 @@
+"""Sparse batch schema: fixed-capacity padded COO.
+
+The reference's in-memory batch is ragged
+(`Data{fea_matrix: vector<vector<kv>>, label: vector<int>}`,
+`/root/reference/src/io/io.h:61-65`). XLA wants static shapes, so a
+batch here is a dense ``[batch, max_nnz]`` block padded with masked
+zeros (SURVEY.md §7 hard part a):
+
+- ``slots``  int32 ``[B, F]`` — table slot per feature occurrence
+  (hashed feature id folded into ``2**log2_slots``; pad = 0, masked).
+- ``fields`` int32 ``[B, F]`` — libffm field-group id (``kv.fgid``,
+  `/root/reference/src/io/io.h:18-22`); needed by MVM, pad = 0.
+- ``mask``   float32 ``[B, F]`` — 1.0 for real feature occurrences.
+- ``labels`` float32 ``[B]`` — {0.0, 1.0}.
+- ``row_mask`` float32 ``[B]`` — 1.0 for real rows (the reference
+  *drops* remainder rows when a block doesn't divide by thread count,
+  `lr_worker.cc:190-194`; we pad-and-mask instead).
+
+Feature *values* are intentionally absent: the reference parser never
+reads the value token (`load_data_from_disk.cc:150-153` breaks after the
+feature id) and no model consumes `kv.val`, so features are binary.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class SparseBatch(NamedTuple):
+    slots: np.ndarray  # int32 [B, F]
+    fields: np.ndarray  # int32 [B, F]
+    mask: np.ndarray  # float32 [B, F]
+    labels: np.ndarray  # float32 [B]
+    row_mask: np.ndarray  # float32 [B]
+
+    @property
+    def batch_size(self) -> int:
+        return self.slots.shape[0]
+
+    @property
+    def max_nnz(self) -> int:
+        return self.slots.shape[1]
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.row_mask.sum())
+
+
+def make_batch(
+    rows_fields: list[np.ndarray],
+    rows_slots: list[np.ndarray],
+    labels: list[float],
+    batch_size: int,
+    max_nnz: int,
+) -> SparseBatch:
+    """Pack ragged rows into one padded SparseBatch.
+
+    Rows longer than ``max_nnz`` are truncated (with a deterministic
+    prefix, matching no reference behavior — the reference has no cap —
+    so pick ``max_nnz`` ≥ the dataset's true max row length; the parser
+    reports truncation via pipeline stats).
+    """
+    n = len(labels)
+    assert n <= batch_size
+    slots = np.zeros((batch_size, max_nnz), dtype=np.int32)
+    fields = np.zeros((batch_size, max_nnz), dtype=np.int32)
+    mask = np.zeros((batch_size, max_nnz), dtype=np.float32)
+    lab = np.zeros((batch_size,), dtype=np.float32)
+    row_mask = np.zeros((batch_size,), dtype=np.float32)
+    for i in range(n):
+        k = min(len(rows_slots[i]), max_nnz)
+        slots[i, :k] = rows_slots[i][:k]
+        fields[i, :k] = rows_fields[i][:k]
+        mask[i, :k] = 1.0
+        lab[i] = labels[i]
+        row_mask[i] = 1.0
+    return SparseBatch(slots=slots, fields=fields, mask=mask, labels=lab, row_mask=row_mask)

@@ -1,0 +1,62 @@
+"""Feature hashing.
+
+The reference hashes the feature-id *string* with `std::hash<std::string>`
+into a 64-bit ps-lite key (`/root/reference/src/io/load_data_from_disk.cc:151`)
+and accepts silent collisions (SURVEY.md §7 hard part e). `std::hash` is
+implementation-defined, so there is nothing to match bit-for-bit; we use a
+fixed, salted FNV-1a 64-bit hash over the feature-id token bytes so that
+Python, NumPy, and the C++ native parser all agree exactly, then map keys
+into a dense ``2**log2_slots`` table with a mask (the TPU analog of the
+ps-lite key-range shard: a dense sharded axis instead of a hash map).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FNV_OFFSET = 0xCBF29CE484222325
+FNV_PRIME = 0x100000001B3
+_MASK64 = (1 << 64) - 1
+
+
+def fnv1a64(data: bytes, salt: int = 0) -> int:
+    """Salted FNV-1a 64-bit hash. Must stay in lockstep with native/parser.cc."""
+    h = (FNV_OFFSET ^ (salt & _MASK64)) & _MASK64
+    for b in data:
+        h ^= b
+        h = (h * FNV_PRIME) & _MASK64
+    return h
+
+
+def hash_token(token: str, salt: int = 0) -> int:
+    return fnv1a64(token.encode("utf-8"), salt)
+
+
+_FINALIZE_MUL = 0xD6E8FEB86659FD93  # splitmix64-style finalizer constant
+
+
+def slot_of(key: int, log2_slots: int) -> int:
+    """Map a 64-bit key to a table slot.
+
+    Applies a mix (xor-shift, multiply, xor-shift) before masking so
+    every bit of the hash influences the slot index for any table size.
+    Must stay in lockstep with slots_of and native/parser.cc.
+    """
+    x = (key ^ (key >> 32)) & _MASK64
+    x = (x * _FINALIZE_MUL) & _MASK64
+    x ^= x >> 32
+    return x & ((1 << log2_slots) - 1)
+
+
+def slots_of(keys: np.ndarray, log2_slots: int) -> np.ndarray:
+    """Vectorized `slot_of` over a uint64 array."""
+    x = keys.astype(np.uint64)
+    x = x ^ (x >> np.uint64(32))
+    with np.errstate(over="ignore"):
+        x = x * np.uint64(_FINALIZE_MUL)
+    x = x ^ (x >> np.uint64(32))
+    return (x & np.uint64((1 << log2_slots) - 1)).astype(np.int64)
+
+
+def hash_tokens(tokens: list[str], salt: int = 0) -> np.ndarray:
+    return np.array([hash_token(t, salt) for t in tokens], dtype=np.uint64)

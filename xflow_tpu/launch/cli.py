@@ -1,0 +1,205 @@
+"""Command-line interface.
+
+Replaces the reference's entry point and launchers:
+
+- `xflow train` ≙ the `xflow_lr` binary's train path
+  (`/root/reference/src/model/main.cc:27-45`: argv = train-prefix,
+  test-prefix, model-index, epochs) plus all the knobs the reference
+  hard-codes;
+- `xflow launch-local` ≙ `scripts/local.sh` (single-machine cluster
+  emulation) — see launch/local.py;
+- `xflow gen-data` — deterministic synthetic libffm shards;
+- `xflow export` — sparse nonzero-weight export from a checkpoint.
+
+Model indices 0/1/2 (LR/FM/MVM) are accepted for reference-CLI parity;
+names are preferred. Arbitrary config overrides: `--set a.b.c=value`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+MODEL_INDEX = {"0": "lr", "1": "fm", "2": "mvm"}
+
+
+def _add_common(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                    help="dotted config override, e.g. --set optim.name=sgd")
+
+
+def _build_config(args) -> "Config":
+    from xflow_tpu.config import Config, override
+
+    cfg = Config()
+    pairs = {}
+    if getattr(args, "train", None):
+        pairs["data.train_path"] = args.train
+    if getattr(args, "test", None):
+        pairs["data.test_path"] = args.test
+    if getattr(args, "model", None):
+        pairs["model.name"] = MODEL_INDEX.get(args.model, args.model)
+    if getattr(args, "epochs", None) is not None:
+        pairs["train.epochs"] = args.epochs
+    if getattr(args, "batch_size", None) is not None:
+        pairs["data.batch_size"] = args.batch_size
+    if getattr(args, "optimizer", None):
+        pairs["optim.name"] = args.optimizer
+    if getattr(args, "log2_slots", None) is not None:
+        pairs["data.log2_slots"] = args.log2_slots
+    if getattr(args, "checkpoint_dir", None):
+        pairs["train.checkpoint_dir"] = args.checkpoint_dir
+    for item in args.set:
+        k, _, v = item.partition("=")
+        pairs[k] = v
+    return override(cfg, **pairs)
+
+
+def cmd_train(args) -> int:
+    from xflow_tpu.parallel.distributed import maybe_initialize
+
+    rank = maybe_initialize(args.coordinator, args.num_processes, args.process_id)
+    cfg = _build_config(args)
+
+    import jax
+
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.train.trainer import Trainer
+
+    mesh = None
+    if not args.no_mesh and len(jax.devices()) > 1:
+        mesh = make_mesh(cfg)
+    trainer = Trainer(cfg, mesh=mesh, process_index=rank)
+    resumed = trainer.maybe_restore()
+    if resumed:
+        print(f"resumed from step {int(trainer.state.step)}", file=sys.stderr)
+    res = trainer.fit()
+    summary = {
+        "rank": rank,
+        "steps": res.steps,
+        "epochs": res.epochs,
+        "examples": res.examples,
+        "seconds": round(res.seconds, 3),
+        "examples_per_sec": round(res.examples_per_sec, 1),
+        "last_loss": res.last_loss,
+    }
+    # reference: only rank 0 runs predict (lr_worker.cc:211-215); here the
+    # eval contains collectives, so every process participates and rank 0
+    # reports/dumps
+    if cfg.data.test_path:
+        import jax as _jax
+
+        if rank == 0 or _jax.process_count() > 1:
+            auc, ll = trainer.evaluate()
+            if rank == 0:
+                summary["auc"], summary["logloss"] = auc, ll
+                print(f"logloss: {ll}\tauc = {auc}", file=sys.stderr)
+    if rank == 0:
+        print(json.dumps(summary))
+    return 0
+
+
+def cmd_gen_data(args) -> int:
+    from xflow_tpu.data.synth import generate_shards
+
+    paths = generate_shards(
+        args.out_prefix, args.shards, args.rows,
+        num_fields=args.fields, ids_per_field=args.ids_per_field, seed=args.seed,
+        truth_seed=args.truth_seed,
+    )
+    print("\n".join(paths))
+    return 0
+
+
+def cmd_export(args) -> int:
+    import os
+
+    import numpy as np
+
+    from xflow_tpu.train.checkpoint import export_sparse_array, latest_step
+
+    step = latest_step(args.checkpoint_dir)
+    if step is None:
+        print(f"no committed checkpoint in {args.checkpoint_dir}", file=sys.stderr)
+        return 1
+    data = np.load(os.path.join(args.checkpoint_dir, f"step_{step}", "state.npz"))
+    n = export_sparse_array(data[f"tables/{args.table}"], args.out)
+    print(json.dumps({"step": step, "table": args.table, "nonzero": n}))
+    return 0
+
+
+def cmd_launch_local(args) -> int:
+    from xflow_tpu.launch.local import launch_local
+
+    return launch_local(args.num_processes, args.forward, port=args.port)
+
+
+def _apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS / XFLOW_NUM_CPU_DEVICES even when an ambient
+    site config pins another platform (this image pins a TPU plugin)."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    ncpu = os.environ.get("XFLOW_NUM_CPU_DEVICES")
+    if plat or ncpu:
+        import jax
+
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        if ncpu:
+            jax.config.update("jax_num_cpu_devices", int(ncpu))
+
+
+def main(argv=None) -> int:
+    _apply_platform_env()
+    ap = argparse.ArgumentParser(prog="xflow", description="TPU-native sparse CTR training")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    tr = sub.add_parser("train", help="train a model (LR/FM/MVM)")
+    tr.add_argument("--train", required=True, help="train shard prefix (reads <prefix>-%%05d)")
+    tr.add_argument("--test", default="", help="test shard prefix")
+    tr.add_argument("--model", default="lr", help="lr|fm|mvm or reference index 0|1|2")
+    tr.add_argument("--epochs", type=int, default=None)
+    tr.add_argument("--batch-size", type=int, default=None)
+    tr.add_argument("--optimizer", default=None, help="ftrl|sgd")
+    tr.add_argument("--log2-slots", type=int, default=None)
+    tr.add_argument("--checkpoint-dir", default=None)
+    tr.add_argument("--no-mesh", action="store_true", help="force single-device")
+    tr.add_argument("--coordinator", default=None, help="host:port of rank 0 (multi-host)")
+    tr.add_argument("--num-processes", type=int, default=None)
+    tr.add_argument("--process-id", type=int, default=None)
+    _add_common(tr)
+    tr.set_defaults(fn=cmd_train)
+
+    gd = sub.add_parser("gen-data", help="generate synthetic libffm shards")
+    gd.add_argument("out_prefix")
+    gd.add_argument("--shards", type=int, default=3)
+    gd.add_argument("--rows", type=int, default=1000)
+    gd.add_argument("--fields", type=int, default=18)
+    gd.add_argument("--ids-per-field", type=int, default=10_000)
+    gd.add_argument("--seed", type=int, default=0)
+    gd.add_argument("--truth-seed", type=int, default=None,
+                    help="seed for the planted ground truth (default: --seed); use the "
+                         "same value for train/test splits generated with different --seed")
+    gd.set_defaults(fn=cmd_gen_data)
+
+    ex = sub.add_parser("export", help="export nonzero weights from a checkpoint")
+    ex.add_argument("checkpoint_dir")
+    ex.add_argument("--table", default="w")
+    ex.add_argument("--out", required=True)
+    ex.set_defaults(fn=cmd_export)
+
+    ll = sub.add_parser("launch-local", help="fork a local multi-process cluster (scripts/local.sh analog)")
+    ll.add_argument("--num-processes", type=int, default=2)
+    ll.add_argument("--port", type=int, default=0, help="coordinator port (0 = pick free)")
+    ll.add_argument("forward", nargs=argparse.REMAINDER,
+                    help="-- followed by `xflow train` args to run in every process")
+    ll.set_defaults(fn=cmd_launch_local)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

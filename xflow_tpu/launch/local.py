@@ -1,0 +1,47 @@
+"""Local multi-process cluster emulation.
+
+The reference's `scripts/local.sh:16-35` forks one scheduler + S servers
++ W workers of the same binary on 127.0.0.1 with `DMLC_*` role env vars.
+The SPMD analog forks N identical `xflow train` processes pointed at a
+local coordinator; rank k reads shard `<prefix>-%05d` % k (same
+convention as `lr_worker.cc:210`). Each process sees only its own
+devices (CPU here), so this exercises the true multi-process path:
+rendezvous, global mesh, cross-process collectives.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_processes: int, forward_args: list[str], port: int = 0) -> int:
+    if forward_args and forward_args[0] == "--":
+        forward_args = forward_args[1:]
+    port = port or _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    procs = []
+    for rank in range(num_processes):
+        env = dict(os.environ)
+        env.update(
+            XFLOW_COORDINATOR=coordinator,
+            XFLOW_NUM_PROCESSES=str(num_processes),
+            XFLOW_PROCESS_ID=str(rank),
+            JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+        )
+        cmd = [sys.executable, "-m", "xflow_tpu", "train", *forward_args]
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc

@@ -1,0 +1,93 @@
+"""Evaluation metrics: pCTR, AUC, logloss.
+
+Reference: `/root/reference/src/base/base.h`.
+
+- `reference_pctr` keeps the reference sigmoid's clamping behavior
+  (`base.h:54-63`: x < −30 → 1e-6, x > 30 → 1.0) so dumped predictions
+  are comparable.
+- `auc_logloss` is the reference's rank-sum AUC (`base.h:84-110`: sort
+  by pctr desc, accumulate true-positive count at each negative,
+  normalize by tp·fp). Two reference accidents fixed (SURVEY.md §7):
+  logloss uses natural log, not `std::log2` (`base.h:97`), and the
+  accumulator is not carried across calls (`base.h:113` never resets).
+- `BucketAUC` is a streaming, device-side alternative: histogram
+  positives/negatives by score bucket; counts are summable across
+  batches and hosts (psum/allreduce) so giant eval sets never need a
+  global sort.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def reference_pctr(logits: jnp.ndarray) -> jnp.ndarray:
+    """σ with the reference's clamps (`base.h:54-63`)."""
+    p = jax.nn.sigmoid(logits)
+    p = jnp.where(logits < -30.0, 1e-6, p)
+    p = jnp.where(logits > 30.0, 1.0, p)
+    return p
+
+
+def auc_logloss(pctrs: np.ndarray, labels: np.ndarray, log2: bool = False) -> tuple[float, float]:
+    """Rank-sum AUC + mean logloss on host. Returns (auc, logloss).
+
+    AUC is NaN when one class is absent (the reference prints only tp_n
+    then, `base.h:102-103`).
+    """
+    pctrs = np.asarray(pctrs, dtype=np.float64)
+    labels = np.asarray(labels, dtype=np.float64)
+    order = np.argsort(-pctrs, kind="stable")
+    sorted_labels = labels[order]
+    tp = np.cumsum(sorted_labels)
+    area = float((tp * (1.0 - sorted_labels)).sum())
+    tp_n = float(sorted_labels.sum())
+    fp_n = float(len(labels) - tp_n)
+    auc = area / (tp_n * fp_n) if tp_n > 0 and fp_n > 0 else float("nan")
+    eps = 1e-15
+    p = np.clip(pctrs, eps, 1.0 - eps)
+    ll = labels * np.log(p) + (1.0 - labels) * np.log(1.0 - p)
+    if log2:
+        ll = ll / np.log(2.0)
+    return auc, float(ll.mean())
+
+
+class BucketAUC(NamedTuple):
+    """Streaming AUC state: per-bucket positive/negative counts."""
+
+    pos: jnp.ndarray  # [num_buckets]
+    neg: jnp.ndarray  # [num_buckets]
+
+    @staticmethod
+    def init(num_buckets: int = 8192) -> "BucketAUC":
+        z = jnp.zeros((num_buckets,), dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+        return BucketAUC(pos=z, neg=z)
+
+    def update(self, pctrs: jnp.ndarray, labels: jnp.ndarray, weights=None) -> "BucketAUC":
+        nb = self.pos.shape[0]
+        idx = jnp.clip((pctrs * nb).astype(jnp.int32), 0, nb - 1)
+        w = jnp.ones_like(pctrs) if weights is None else weights
+        pos = self.pos.at[idx].add(labels * w)
+        neg = self.neg.at[idx].add((1.0 - labels) * w)
+        return BucketAUC(pos=pos, neg=neg)
+
+    def compute(self) -> float:
+        """AUC from bucket counts (ties within a bucket count 1/2)."""
+        pos, neg = np.asarray(self.pos, np.float64), np.asarray(self.neg, np.float64)
+        tp_n, fp_n = pos.sum(), neg.sum()
+        if tp_n == 0 or fp_n == 0:
+            return float("nan")
+        pos_below = np.concatenate([[0.0], np.cumsum(pos)[:-1]])
+        area = (neg * (tp_n - pos_below - pos) + neg * pos * 0.5).sum()
+        # area counts (pos ranked above neg) pairs: positives in strictly
+        # higher buckets + half the same-bucket ties.
+        return float(area / (tp_n * fp_n))
+
+
+def binary_logloss_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Numerically stable per-row BCE in nats: softplus(x) − y·x."""
+    return jax.nn.softplus(logits) - labels * logits

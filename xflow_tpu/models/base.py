@@ -1,0 +1,74 @@
+"""Model interface.
+
+A model is a set of named parameter tables plus a pure forward function
+from (tables, batch) to logits. Tables are dense ``[num_slots]`` or
+``[num_slots, v_dim]`` arrays sharded on the slot axis (the TPU analog
+of ps-lite's key-range-sharded server tables, SURVEY.md §2 C2/C13).
+Gradients come from `jax.grad` through the table gathers — the gather
+is the reference's Pull, its transpose (scatter-add) is the Push.
+
+The reference's model zoo and table usage:
+- LR: table w (dim 1)            (`/root/reference/src/model/lr/`)
+- FM: tables w (dim 1) + v (dim k) (`/root/reference/src/model/fm/`)
+- MVM: table v (dim k) only        (`/root/reference/src/model/mvm/`,
+  pushes only v: `mvm_worker.cc:270`)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.config import Config
+
+
+@dataclass(frozen=True)
+class Model:
+    name: str
+    # table name -> trailing dims ( () for scalar table, (v_dim,) for latent )
+    table_specs: Callable[[Config], Dict[str, tuple]]
+    # (tables, batch_arrays, cfg) -> logits [B]
+    forward: Callable
+
+
+_REGISTRY: Dict[str, Model] = {}
+
+
+def register_model(model: Model) -> Model:
+    _REGISTRY[model.name] = model
+    return model
+
+
+def get_model(name: str) -> Model:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def init_tables(model: Model, cfg: Config, key: jax.Array) -> Dict[str, jax.Array]:
+    """Build dense parameter tables.
+
+    w-tables init to 0 (reference: default-constructed FTRL entries,
+    `ftrl.h:27-36`). v-tables init ~N(0,1)*v_init_scale for FTRL
+    (`ftrl.h:117`) or constant v_init_sgd for SGD (`sgd.h:69`) — the
+    reference does this lazily per touched key; dense pre-init is
+    equivalent because untouched slots are never read meaningfully.
+    """
+    tables = {}
+    specs = model.table_specs(cfg)
+    for tname, trailing in sorted(specs.items()):
+        shape = (cfg.num_slots,) + trailing
+        if trailing == ():
+            tables[tname] = jnp.zeros(shape, dtype=jnp.float32)
+        else:
+            key, sub = jax.random.split(key)
+            if cfg.optim.name == "sgd":
+                tables[tname] = jnp.full(shape, cfg.optim.v_init_sgd, dtype=jnp.float32)
+            else:
+                tables[tname] = (
+                    jax.random.normal(sub, shape, dtype=jnp.float32) * cfg.optim.v_init_scale
+                )
+    return tables

@@ -1,0 +1,51 @@
+"""Factorization machine.
+
+Reference: `/root/reference/src/model/fm/fm_worker.cc`. Its forward
+(`calculate_loss`, `fm_worker.cc:159-202`) computes
+σ(wx + S² − Q) where S and Q accumulate v and v² over *both* the
+feature and the latent axes (`fm_worker.cc:178-196`: `v_sum[sid]` is
+indexed by row only, inside the k loop), i.e. latent dims are coupled
+through one scalar — and its hand-written w-gradient is accumulated
+once per latent dim (`fm_worker.cc:134-148`), scaling it by k. Both are
+accidents relative to Rendle's FM (SURVEY.md §7: fix, not replicate).
+
+Default here is the standard FM second-order term, per latent dim:
+  ½ Σₖ [(Σᵢ v_{ik})² − Σᵢ v²_{ik}]
+with `cfg.model.fm_half=False` dropping the ½ (the reference also omits
+it) and `cfg.model.fm_standard=False` reproducing the reference's
+coupled form exactly for parity experiments. Gradients are exact
+(`jax.grad`), not the reference's approximation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import Model, register_model
+
+
+def _table_specs(cfg):
+    return {"w": (), "v": (cfg.model.v_dim,)}
+
+
+def forward(tables, batch, cfg):
+    w, v = tables["w"], tables["v"]
+    mask = batch["mask"]
+    wg = w[batch["slots"]]  # [B, F]
+    wx = (wg * mask).sum(axis=-1)
+    vg = v[batch["slots"]] * mask[..., None]  # [B, F, k]
+    if cfg.model.fm_standard:
+        s = vg.sum(axis=1)  # [B, k]
+        q = (vg * vg).sum(axis=1)  # [B, k]
+        second = (s * s - q).sum(axis=-1)
+        if cfg.model.fm_half:
+            second = 0.5 * second
+    else:
+        # reference-coupled form: one scalar accumulator across (i, k)
+        s = vg.sum(axis=(1, 2))
+        q = (vg * vg).sum(axis=(1, 2))
+        second = s * s - q
+    return wx + second
+
+
+MODEL = register_model(Model(name="fm", table_specs=_table_specs, forward=forward))

@@ -1,0 +1,29 @@
+"""Sparse logistic regression.
+
+Reference: `/root/reference/src/model/lr/lr_worker.cc` — forward is
+σ(Σᵢ w[fidᵢ]) per row (`calculate_loss`, `lr_worker.cc:121-143`, via a
+sorted merge-join of the pulled weights against per-row keys). Here the
+same contraction is one masked gather-sum, and the reference's explicit
+gradient (residual scattered back per key then divided by batch size,
+`lr_worker.cc:100-119`) falls out of `jax.grad` of the mean logloss.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import Model, register_model
+
+
+def _table_specs(cfg):
+    return {"w": ()}
+
+
+def forward(tables, batch, cfg):
+    w = tables["w"]
+    # Pull ≡ gather. [B, F] weights for every feature occurrence.
+    wg = w[batch["slots"]]
+    return (wg * batch["mask"]).sum(axis=-1)
+
+
+MODEL = register_model(Model(name="lr", table_specs=_table_specs, forward=forward))

@@ -1,0 +1,53 @@
+"""Multi-view machine.
+
+Reference: `/root/reference/src/model/mvm/mvm_worker.cc`. Per latent
+dim k it sums v over the features of each libffm field ("view"):
+`v_sum[k][row][fgid] += v` (`mvm_worker.cc:182-196`), takes the product
+over fields (`:198-205`), sums over k (`:207-212`), and applies σ.
+
+Reference accidents not replicated (SURVEY.md §7):
+- per-row field range is `[0, max_fgid)` sized by the *max* field id
+  seen, so the max field's accumulation writes one past the vector end
+  (`mvm_worker.cc:43` vs `:75` — out-of-bounds UB); we use the
+  configured `num_fields` and multiply only over fields present in the
+  row (absent fields contribute the multiplicative identity rather than
+  a hard 0);
+- its hand gradient divides by `1 + v_sum` while the forward's product
+  has no `1 +` (`mvm_worker.cc:153-157` vs `:202` — the `1+` variant is
+  commented out at `:201`), and zero-guards inconsistently; we use the
+  exact gradient via `jax.grad`;
+- predict iterates `v_multi.size()` = k rows instead of the batch
+  (`mvm_worker.cc:96`), truncating evaluation to 10 rows per block.
+
+The per-(row, field) segment-sum is expressed as a one-hot einsum —
+a [F, num_fields] × [F, k] batched matmul that XLA maps onto the MXU —
+rather than a scatter, keeping the hot path dense and fusible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.models.base import Model, register_model
+
+
+def _table_specs(cfg):
+    return {"v": (cfg.model.v_dim,)}
+
+
+def forward(tables, batch, cfg):
+    v = tables["v"]
+    nf = cfg.model.num_fields
+    mask = batch["mask"]
+    vg = v[batch["slots"]] * mask[..., None]  # [B, F, k]
+    onehot = (batch["fields"][..., None] == jnp.arange(nf)) * mask[..., None]  # [B, F, nf]
+    # full-precision einsum: the contraction is tiny (F × nf × k) and the
+    # downstream product-of-fields amplifies any bf16 rounding
+    s = jnp.einsum("bfn,bfk->bnk", onehot, vg, precision=jax.lax.Precision.HIGHEST)
+    present = onehot.sum(axis=1) > 0  # [B, nf]
+    factors = jnp.where(present[..., None], s, 1.0)
+    return jnp.prod(factors, axis=1).sum(axis=-1)  # [B]
+
+
+MODEL = register_model(Model(name="mvm", table_specs=_table_specs, forward=forward))

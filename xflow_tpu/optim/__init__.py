@@ -1,0 +1,4 @@
+from xflow_tpu.optim.base import Optimizer, get_optimizer
+from xflow_tpu.optim import ftrl, sgd  # noqa: F401  (registration side effects)
+
+__all__ = ["Optimizer", "get_optimizer"]

@@ -1,0 +1,52 @@
+"""FTRL-proximal (McMahan et al., "Ad Click Prediction: a View from the
+Trenches" — the paper the reference README cites).
+
+Math is exactly `/root/reference/src/optimizer/ftrl.h:58-74` (w table)
+and `:124-141` (v table), per element:
+
+    n' = n + g²
+    z' = z + g − (√n' − √n)/α · w
+    w' = 0                                  if |z'| ≤ λ1
+       = −(z' − sign(z')·λ1) / ((β + √n')/α + λ2)   otherwise
+
+applied to dense (w, n, z) arrays instead of lazily-constructed hash-map
+entries. Hyperparameter defaults match `ftrl.h:17-20`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.optim.base import Optimizer, register_optimizer
+
+
+def _init_state(tables):
+    return {
+        name: {"n": jnp.zeros_like(t), "z": jnp.zeros_like(t)} for name, t in tables.items()
+    }
+
+
+def _update_one(w, n, z, g, alpha, beta, lambda1, lambda2):
+    n_new = n + g * g
+    z_new = z + g - (jnp.sqrt(n_new) - jnp.sqrt(n)) / alpha * w
+    shrink = jnp.sign(z_new) * lambda1
+    denom = (beta + jnp.sqrt(n_new)) / alpha + lambda2
+    w_new = jnp.where(jnp.abs(z_new) <= lambda1, 0.0, -(z_new - shrink) / denom)
+    return w_new, n_new, z_new
+
+
+def _apply(tables, opt_state, grads, cfg):
+    hp = cfg.optim.ftrl
+    new_tables, new_state = {}, {}
+    for name, w in tables.items():
+        st, g = opt_state[name], grads[name]
+        w_new, n_new, z_new = _update_one(
+            w, st["n"], st["z"], g, hp.alpha, hp.beta, hp.lambda1, hp.lambda2
+        )
+        new_tables[name] = w_new
+        new_state[name] = {"n": n_new, "z": z_new}
+    return new_tables, new_state
+
+
+OPTIMIZER = register_optimizer(Optimizer(name="ftrl", init_state=_init_state, apply=_apply))

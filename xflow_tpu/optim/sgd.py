@@ -1,0 +1,23 @@
+"""Plain SGD.
+
+Reference: `/root/reference/src/optimizer/sgd.h` — `w -= lr·g` with
+lr = 0.001 (`sgd.h:16,51-52`), same handle structure for the w and v
+tables. Stateless.
+"""
+
+from __future__ import annotations
+
+from xflow_tpu.optim.base import Optimizer, register_optimizer
+
+
+def _init_state(tables):
+    return {name: {} for name in tables}
+
+
+def _apply(tables, opt_state, grads, cfg):
+    lr = cfg.optim.sgd.lr
+    new_tables = {name: w - lr * grads[name] for name, w in tables.items()}
+    return new_tables, opt_state
+
+
+OPTIMIZER = register_optimizer(Optimizer(name="sgd", init_state=_init_state, apply=_apply))

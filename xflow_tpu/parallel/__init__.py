@@ -1,0 +1,15 @@
+from xflow_tpu.parallel.mesh import make_mesh, table_sharding, batch_sharding
+from xflow_tpu.parallel.train_step import (
+    make_sharded_train_step,
+    make_sharded_eval_step,
+    shard_state,
+)
+
+__all__ = [
+    "make_mesh",
+    "table_sharding",
+    "batch_sharding",
+    "make_sharded_train_step",
+    "make_sharded_eval_step",
+    "shard_state",
+]

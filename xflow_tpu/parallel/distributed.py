@@ -1,0 +1,46 @@
+"""Multi-host initialization.
+
+The reference's cluster bring-up is env-driven role dispatch: a ZMQ
+rendezvous at the scheduler (`DMLC_PS_ROOT_URI/PORT`,
+`scripts/local.sh:8-19`) sorts processes into scheduler/server/worker.
+Here every process is an identical SPMD rank: `jax.distributed.initialize`
+replaces the scheduler rendezvous (coordinator address), and the
+server/worker split collapses into the mesh axes (SURVEY.md §2 C13).
+
+Environment variables (the launcher sets these; compatible names kept
+close to the reference's so migration is mechanical):
+
+- ``XFLOW_COORDINATOR`` — ``host:port`` of rank 0 (reference:
+  ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``)
+- ``XFLOW_NUM_PROCESSES`` — world size (reference: ``DMLC_NUM_WORKER``)
+- ``XFLOW_PROCESS_ID`` — this rank
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def maybe_initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Initialize jax.distributed if configured; returns this process's rank."""
+    coordinator = coordinator or os.environ.get("XFLOW_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("XFLOW_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid_env = os.environ.get("XFLOW_PROCESS_ID")
+        process_id = int(pid_env) if pid_env is not None else None
+    if coordinator and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return jax.process_index()
+    return 0

@@ -1,0 +1,88 @@
+"""Sharded train/eval steps.
+
+The single-device step (train/step.py) IS the multi-device step: the
+program is written once over logical arrays, shardings are attached to
+the inputs, and GSPMD partitions the computation — the table gather
+(Pull) and its scatter-add transpose (Push) lower to cross-chip
+collectives over ICI/DCN, and the loss/metric reductions to psums.
+This is the design center of the rebuild (SURVEY.md §2 C13): where the
+reference hand-routes sparse KV RPC over ZeroMQ, here the compiler
+emits the communication from sharding annotations.
+
+Explicit in/out shardings are passed to `jax.jit` so the step never
+silently falls back to replicated tables, and the donated input state
+buffer is reused for the output (in-place HBM update, like the server's
+in-place hash-map mutation — but functional).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from xflow_tpu.config import Config
+from xflow_tpu.models.base import Model
+from xflow_tpu.optim.base import Optimizer
+from xflow_tpu.parallel.mesh import batch_sharding, replicated, state_shardings
+from xflow_tpu.train.state import TrainState
+from xflow_tpu.train.step import make_train_step, make_eval_step
+
+
+def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place an (unsharded) TrainState onto the mesh's table sharding."""
+    shardings = state_shardings(state, mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), state, shardings)
+
+
+def make_sharded_train_step(
+    model: Model, optimizer: Optimizer, cfg: Config, mesh: Mesh
+) -> Callable:
+    step = make_train_step(model, optimizer, cfg, jit=False)
+    # state shardings depend only on pytree structure; build from a spec of
+    # the real state at first call via jit's lazy specialization
+    bsh = batch_sharding(mesh)
+
+    def sharded(state: TrainState, batch: dict):
+        return step(state, batch)
+
+    out_metrics_sh = {"loss": replicated(mesh), "rows": replicated(mesh)}
+
+    def wrap(state: TrainState, batch: dict):
+        ssh = state_shardings(state, mesh)
+        return jax.jit(
+            sharded,
+            in_shardings=(ssh, bsh),
+            out_shardings=(ssh, out_metrics_sh),
+            donate_argnums=(0,),
+        )
+
+    # cache the jitted fn once the state structure is known
+    cache = {}
+
+    def call(state: TrainState, batch: dict):
+        key = "step"
+        if key not in cache:
+            cache[key] = wrap(state, batch)
+        return cache[key](state, batch)
+
+    return call
+
+
+def make_sharded_eval_step(model: Model, cfg: Config, mesh: Mesh) -> Callable:
+    ev = make_eval_step(model, cfg, jit=False)
+    bsh = batch_sharding(mesh)
+    cache = {}
+
+    def call(tables, batch):
+        if "ev" not in cache:
+            tsh = state_shardings(tables, mesh)
+            cache["ev"] = jax.jit(
+                ev,
+                in_shardings=(tsh, bsh),
+                out_shardings=NamedSharding(mesh, P("data")),
+            )
+        return cache["ev"](tables, batch)
+
+    return call

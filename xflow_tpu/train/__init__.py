@@ -1,0 +1,4 @@
+from xflow_tpu.train.state import TrainState, init_state
+from xflow_tpu.train.step import make_train_step, make_eval_step, loss_fn
+
+__all__ = ["TrainState", "init_state", "make_train_step", "make_eval_step", "loss_fn"]

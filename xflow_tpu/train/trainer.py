@@ -1,0 +1,268 @@
+"""Training orchestration.
+
+The reference's worker loop (`LRWorker::batch_training`,
+`/root/reference/src/model/lr/lr_worker.cc:179-205`: epochs → IO blocks
+→ thread fan-out → Pull/compute/Push) and its rank-0 predict pass
+(`lr_worker.cc:207-217`) become: epochs → prefetched padded batches →
+one jitted SPMD step; then an eval pass that dumps
+``pred_<rank>_<block>.txt`` rows (``pctr\\t1-label\\tlabel``,
+`lr_worker.cc:67`) and prints logloss/AUC like `base.h:101-108`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xflow_tpu.config import Config
+from xflow_tpu.data.libffm import shard_path
+from xflow_tpu.data.pipeline import batch_iterator, prefetch
+from xflow_tpu.metrics import auc_logloss
+from xflow_tpu.models import get_model
+from xflow_tpu.optim import get_optimizer
+from xflow_tpu.train.state import TrainState, init_state
+from xflow_tpu.train.step import batch_to_arrays, make_eval_step, make_train_step
+
+
+@dataclass
+class TrainResult:
+    steps: int = 0
+    epochs: int = 0
+    examples: int = 0
+    seconds: float = 0.0
+    last_loss: float = float("nan")
+    auc: float = float("nan")
+    logloss: float = float("nan")
+
+    @property
+    def examples_per_sec(self) -> float:
+        return self.examples / self.seconds if self.seconds > 0 else 0.0
+
+
+class MetricsLogger:
+    """Structured per-step metrics: JSONL to a file, or quiet."""
+
+    def __init__(self, path: str = ""):
+        self._f = open(path, "a") if path else None
+
+    def log(self, record: dict) -> None:
+        if self._f:
+            self._f.write(json.dumps(record) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+
+
+class Trainer:
+    def __init__(self, cfg: Config, mesh=None, process_index: int = 0):
+        self.cfg = cfg
+        self.model = get_model(cfg.model.name)
+        self.optimizer = get_optimizer(cfg.optim.name)
+        self.mesh = mesh
+        self.rank = process_index
+        if mesh is not None:
+            from xflow_tpu.parallel.train_step import make_sharded_train_step, make_sharded_eval_step, shard_state
+
+            self.state = shard_state(
+                init_state(self.model, self.optimizer, cfg), mesh
+            )
+            self.train_step = make_sharded_train_step(self.model, self.optimizer, cfg, mesh)
+            self.eval_step = make_sharded_eval_step(self.model, cfg, mesh)
+            self._shard_batch = lambda b: _shard_batch_arrays(b, mesh)
+        else:
+            self.state = init_state(self.model, self.optimizer, cfg)
+            self.train_step = make_train_step(self.model, self.optimizer, cfg)
+            self.eval_step = make_eval_step(self.model, cfg)
+            self._shard_batch = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
+        self.metrics = MetricsLogger(cfg.train.metrics_path)
+        # MVM keys its views on the field id: a field >= num_fields would be
+        # silently dropped by the one-hot, so reject it loudly
+        self._validate_fields = cfg.model.name == "mvm"
+
+    def _check_batch(self, batch) -> None:
+        if self._validate_fields:
+            max_field = int(np.max(batch.fields)) if batch.fields.size else 0
+            if max_field >= self.cfg.model.num_fields:
+                raise ValueError(
+                    f"libffm field id {max_field} >= model.num_fields="
+                    f"{self.cfg.model.num_fields}; raise model.num_fields"
+                )
+
+    # -------------------------------------------------------- multi-process IO
+    def _coordinated_batches(self, iterator):
+        """Yield local batches, padding with empty ones until every process's
+        input is exhausted.
+
+        SPMD steps are collective: if process A has 10 batches and process B
+        has 9 (ragged shards — the reference tolerates this because its
+        workers never synchronize), B would deadlock A. Each step the
+        processes agree (tiny allgather) whether anyone still has data;
+        exhausted ranks contribute fully-masked empty batches.
+        """
+        if jax.process_count() == 1:
+            yield from iterator
+            return
+        from jax.experimental import multihost_utils
+
+        from xflow_tpu.data.schema import SparseBatch
+
+        cfg = self.cfg.data
+        it = iter(iterator)
+        while True:
+            try:
+                batch = next(it)
+                have = np.int32(1)
+            except (StopIteration, FileNotFoundError):
+                batch, have = None, np.int32(0)
+                it = iter(())  # a missing local shard counts as exhausted
+            counts = np.asarray(multihost_utils.process_allgather(have))
+            if counts.max() == 0:
+                return
+            if batch is None:
+                B, F = cfg.batch_size, cfg.max_nnz
+                batch = SparseBatch(
+                    slots=np.zeros((B, F), np.int32),
+                    fields=np.zeros((B, F), np.int32),
+                    mask=np.zeros((B, F), np.float32),
+                    labels=np.zeros((B,), np.float32),
+                    row_mask=np.zeros((B,), np.float32),
+                )
+            yield batch
+
+    # ------------------------------------------------------------------ train
+    def fit(self, train_path: Optional[str] = None) -> TrainResult:
+        cfg = self.cfg
+        path = train_path or shard_path(cfg.data.train_path, self.rank)
+        res = TrainResult()
+        start = time.time()
+        if cfg.train.profile_dir:
+            jax.profiler.start_trace(cfg.train.profile_dir)
+        try:
+            for epoch in range(cfg.train.epochs):
+                for batch in self._coordinated_batches(
+                    prefetch(batch_iterator(path, cfg.data))
+                ):
+                    self._check_batch(batch)
+                    arrays = self._shard_batch(batch_to_arrays(batch))
+                    self.state, m = self.train_step(self.state, arrays)
+                    res.steps += 1
+                    res.examples += batch.num_rows
+                    if cfg.train.log_every and res.steps % cfg.train.log_every == 0:
+                        res.last_loss = float(m["loss"])
+                        self.metrics.log(
+                            {
+                                "step": res.steps,
+                                "epoch": epoch,
+                                "loss": res.last_loss,
+                                "examples": res.examples,
+                                "elapsed_s": round(time.time() - start, 3),
+                            }
+                        )
+                    if (
+                        cfg.train.checkpoint_dir
+                        and cfg.train.checkpoint_every
+                        and res.steps % cfg.train.checkpoint_every == 0
+                    ):
+                        self.save_checkpoint()
+                res.epochs = epoch + 1
+                if (epoch + 1) % 30 == 0:
+                    print(f"epoch : {epoch}", file=sys.stderr)
+                if cfg.train.eval_every and (epoch + 1) % cfg.train.eval_every == 0:
+                    auc, ll = self.evaluate(dump=False)
+                    self.metrics.log({"epoch": epoch, "eval_auc": auc, "eval_logloss": ll})
+            if "m" in dir():
+                res.last_loss = float(m["loss"])
+        finally:
+            if cfg.train.profile_dir:
+                jax.profiler.stop_trace()
+        res.seconds = time.time() - start
+        if cfg.train.checkpoint_dir:
+            self.save_checkpoint()
+        return res
+
+    # ------------------------------------------------------------------- eval
+    def evaluate(
+        self, test_path: Optional[str] = None, dump: Optional[bool] = None, block: int = 0
+    ) -> tuple[float, float]:
+        """Predict pass. Returns (auc, logloss); optionally dumps pred file."""
+        cfg = self.cfg
+        path = test_path or shard_path(cfg.data.test_path, self.rank)
+        dump = cfg.train.pred_dump if dump is None else dump
+        multiproc = jax.process_count() > 1
+        dump = dump and (not multiproc or self.rank == 0)
+        fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
+        pctrs, labels = [], []
+        for batch in self._coordinated_batches(prefetch(batch_iterator(path, cfg.data))):
+            self._check_batch(batch)
+            arrays = self._shard_batch(batch_to_arrays(batch))
+            p_dev = self.eval_step(self.state.tables, arrays)
+            if multiproc:
+                # the pctr array is sharded over the data axis across
+                # processes; gather rows (and per-process labels) everywhere
+                from jax.experimental import multihost_utils
+
+                p = np.asarray(multihost_utils.process_allgather(p_dev, tiled=True))
+                rm = np.asarray(
+                    multihost_utils.process_allgather(batch.row_mask, tiled=False)
+                ).reshape(-1) > 0
+                y_all = np.asarray(
+                    multihost_utils.process_allgather(batch.labels, tiled=False)
+                ).reshape(-1)
+            else:
+                p = np.asarray(p_dev)
+                rm = np.asarray(batch.row_mask) > 0
+                y_all = np.asarray(batch.labels)
+            p, y = p[rm], y_all[rm]
+            pctrs.append(p)
+            labels.append(y)
+            if fout:
+                for pi, yi in zip(p, y):
+                    # reference row format: pctr \t 1-label \t label (lr_worker.cc:67)
+                    fout.write(f"{pi:.6f}\t{int(1 - yi)}\t{int(yi)}\n")
+        if fout:
+            fout.close()
+        if not pctrs:
+            return float("nan"), float("nan")
+        auc, ll = auc_logloss(np.concatenate(pctrs), np.concatenate(labels))
+        return auc, ll
+
+    # ------------------------------------------------------------- checkpoint
+    def save_checkpoint(self) -> None:
+        from xflow_tpu.train.checkpoint import save
+
+        save(self.cfg.train.checkpoint_dir, self.state)
+
+    def maybe_restore(self) -> bool:
+        from xflow_tpu.train.checkpoint import latest_step, restore
+
+        if not (self.cfg.train.checkpoint_dir and self.cfg.train.resume):
+            return False
+        if latest_step(self.cfg.train.checkpoint_dir) is None:
+            return False
+        self.state = restore(self.cfg.train.checkpoint_dir, self.state)
+        return True
+
+
+def _shard_batch_arrays(batch: dict, mesh):
+    from xflow_tpu.parallel.mesh import batch_sharding
+
+    sh = batch_sharding(mesh)
+    if jax.process_count() > 1:
+        # each process holds different rows (its own input shard): assemble a
+        # global array from per-process local data (device_put would demand
+        # identical values everywhere)
+        return {
+            k: jax.make_array_from_process_local_data(sh[k], np.asarray(v))
+            for k, v in batch.items()
+        }
+    return {k: jax.device_put(jnp.asarray(v), sh[k]) for k, v in batch.items()}
