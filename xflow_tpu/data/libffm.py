@@ -49,7 +49,11 @@ def parse_line(
         parts = line.split(" ", 1)
         if len(parts) == 1:
             return None
-    label = 1.0 if float(parts[0]) > 1e-7 else 0.0
+    try:
+        label_val = float(parts[0])
+    except ValueError:
+        label_val = 0.0  # reference uses atof, which yields 0 for junk
+    label = 1.0 if label_val > 1e-7 else 0.0
     fields = []
     slots = []
     for tok in parts[1].split():

@@ -1,0 +1,160 @@
+"""ctypes bindings for the C++ data plane (native/parser.cc).
+
+The shared library is compiled on demand with g++ (no pybind11 in the
+image; plain C ABI + ctypes keeps the binding dependency-free) and
+cached next to the source keyed by a source hash. `batch_iterator`
+prefers this path automatically (DataConfig.use_native_parser) and
+falls back to the pure-Python parser if the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Iterator
+
+import numpy as np
+
+from xflow_tpu.config import DataConfig
+from xflow_tpu.data.schema import SparseBatch
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "parser.cc")
+_LIB = None
+
+
+def _build_lib() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "XFLOW_NATIVE_CACHE",
+        os.path.join(os.path.dirname(_SRC), "_build"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"libxfparser_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = tempfile.mktemp(suffix=".so", dir=cache_dir)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+    return so_path
+
+
+def get_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(_build_lib())
+        lib.xf_hash64.restype = ctypes.c_uint64
+        lib.xf_hash64.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_uint64]
+        lib.xf_slot.restype = ctypes.c_uint64
+        lib.xf_slot.argtypes = [ctypes.c_uint64, ctypes.c_int]
+        lib.xf_parser_open.restype = ctypes.c_void_p
+        lib.xf_parser_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.xf_parser_next_batch.restype = ctypes.c_long
+        lib.xf_parser_next_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.xf_parser_truncated.restype = ctypes.c_long
+        lib.xf_parser_truncated.argtypes = [ctypes.c_void_p]
+        lib.xf_parser_close.restype = None
+        lib.xf_parser_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+def native_hash(token: bytes, salt: int = 0) -> int:
+    return int(get_lib().xf_hash64(token, len(token), salt))
+
+
+def native_slot(key: int, log2_slots: int) -> int:
+    return int(get_lib().xf_slot(key, log2_slots))
+
+
+class _NativeBatchStream:
+    """Eagerly-opened batch stream (construction fails fast on a missing
+    file/toolchain, so batch_iterator's guarded construction works)."""
+
+    def __init__(self, path: str, cfg: DataConfig, batch_size: int):
+        self.lib = get_lib()
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self.handle = self.lib.xf_parser_open(path.encode(), cfg.block_bytes)
+        if not self.handle:
+            raise OSError(f"xf_parser_open failed for {path}")
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.closed = False
+        self.started = False
+        self.truncated = 0
+
+    def __iter__(self) -> Iterator[SparseBatch]:
+        # single-shot stream: re-iterating would call into the freed C handle
+        if self.started or self.closed:
+            raise RuntimeError("native batch stream is single-use; re-open the file")
+        self.started = True
+        return self._generate()
+
+    def _generate(self) -> Iterator[SparseBatch]:
+        cfg, B, F = self.cfg, self.batch_size, self.cfg.max_nnz
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        try:
+            while True:
+                slots = np.zeros((B, F), np.int32)
+                fields = np.zeros((B, F), np.int32)
+                mask = np.zeros((B, F), np.float32)
+                labels = np.zeros((B,), np.float32)
+                row_mask = np.zeros((B,), np.float32)
+                n = self.lib.xf_parser_next_batch(
+                    self.handle,
+                    B,
+                    F,
+                    cfg.log2_slots,
+                    cfg.hash_salt,
+                    slots.ctypes.data_as(i32p),
+                    fields.ctypes.data_as(i32p),
+                    mask.ctypes.data_as(f32p),
+                    labels.ctypes.data_as(f32p),
+                    row_mask.ctypes.data_as(f32p),
+                )
+                if n < 0:
+                    raise OSError(f"native parser I/O error reading batches (ferror)")
+                if n == 0:
+                    return
+                if n < B and cfg.drop_remainder:
+                    return
+                yield SparseBatch(slots, fields, mask, labels, row_mask)
+                if n < B:
+                    return
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if not self.closed:
+            self.truncated = int(self.lib.xf_parser_truncated(self.handle))
+            self.lib.xf_parser_close(self.handle)
+            self.closed = True
+            if self.truncated:
+                import sys
+
+                print(
+                    f"xflow: warning: {self.truncated} feature occurrence(s) "
+                    f"truncated by data.max_nnz={self.cfg.max_nnz}",
+                    file=sys.stderr,
+                )
+
+
+def native_batch_iterator(path: str, cfg: DataConfig, batch_size: int):
+    return iter(_NativeBatchStream(path, cfg, batch_size))

@@ -15,6 +15,7 @@ TPU analog of the reference's double-duty IO/compute threads.
 from __future__ import annotations
 
 import queue
+import subprocess
 import threading
 from typing import Iterable, Iterator, Optional
 
@@ -61,7 +62,9 @@ def batch_iterator(
             from xflow_tpu.data.native import native_batch_iterator
 
             native_iter = native_batch_iterator(path, cfg, bs)
-        except (ImportError, OSError, RuntimeError):
+        except FileNotFoundError:
+            raise  # a missing input is the user's error, not a fallback case
+        except (ImportError, OSError, RuntimeError, subprocess.SubprocessError):
             native_iter = None
         if native_iter is not None:
             yield from native_iter
