@@ -1,0 +1,56 @@
+"""Python side of the C ABI (see xflow_c_api.h).
+
+The reference exposes `XFCreate`/`XFStartTrain` wrapping an `LRWorker`
+behind `extern "C"` for FFI embedding (`/root/reference/src/c_api/
+c_api.cc:10-20`, disabled in its build). Here the C shim embeds CPython
+and drives this module; handles are integers into a registry.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict
+
+_registry: Dict[int, dict] = {}
+_ids = itertools.count(1)
+
+
+def create(train_prefix: str, test_prefix: str) -> int:
+    handle = next(_ids)
+    _registry[handle] = {
+        "overrides": {
+            "data.train_path": train_prefix,
+            "data.test_path": test_prefix,
+        },
+        "result": None,
+        "auc": float("nan"),
+    }
+    return handle
+
+
+def set_config(handle: int, key: str, value: str) -> None:
+    _registry[handle]["overrides"][key] = value
+
+
+def start_train(handle: int) -> int:
+    from xflow_tpu.config import Config, override
+    from xflow_tpu.train.trainer import Trainer
+
+    entry = _registry[handle]
+    cfg = override(Config(), **entry["overrides"])
+    trainer = Trainer(cfg)
+    res = trainer.fit()
+    entry["result"] = res
+    if cfg.data.test_path:
+        auc, ll = trainer.evaluate()
+        entry["auc"] = auc
+        entry["logloss"] = ll
+    return 0
+
+
+def get_auc(handle: int) -> float:
+    return float(_registry[handle]["auc"])
+
+
+def destroy(handle: int) -> None:
+    _registry.pop(handle, None)

@@ -1,0 +1,92 @@
+/* C ABI implementation: embeds CPython and drives xflow_tpu.c_api.embed.
+ * See xflow_c_api.h for the contract and build line. */
+
+#include "xflow_c_api.h"
+
+#include <Python.h>
+#include <math.h>
+#include <stdint.h>
+
+static PyObject* g_embed = NULL;
+
+static int ensure_interp(void) {
+  if (g_embed != NULL) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  g_embed = PyImport_ImportModule("xflow_tpu.c_api.embed");
+  if (g_embed == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  return 0;
+}
+
+static PyObject* call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_embed, fn);
+  if (f == NULL) return NULL;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+int XFCreate(void** out_handle, const char* train_prefix, const char* test_prefix) {
+  if (ensure_interp() != 0) return -1;
+  PyObject* r = call("create", Py_BuildValue("(ss)", train_prefix, test_prefix));
+  if (r == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  long h = PyLong_AsLong(r);
+  Py_DECREF(r);
+  *out_handle = (void*)(intptr_t)h;
+  return 0;
+}
+
+int XFSetConfig(void* handle, const char* dotted_key, const char* value) {
+  if (ensure_interp() != 0) return -1;
+  PyObject* r = call("set_config",
+                     Py_BuildValue("(lss)", (long)(intptr_t)handle, dotted_key, value));
+  if (r == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int XFStartTrain(void* handle) {
+  if (ensure_interp() != 0) return -1;
+  PyObject* r = call("start_train", Py_BuildValue("(l)", (long)(intptr_t)handle));
+  if (r == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  long rc = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return (int)rc;
+}
+
+double XFGetAUC(void* handle) {
+  if (ensure_interp() != 0) return NAN;
+  PyObject* r = call("get_auc", Py_BuildValue("(l)", (long)(intptr_t)handle));
+  if (r == NULL) {
+    PyErr_Print();
+    return NAN;
+  }
+  double auc = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return auc;
+}
+
+int XFDestroy(void* handle) {
+  if (ensure_interp() != 0) return -1;
+  PyObject* r = call("destroy", Py_BuildValue("(l)", (long)(intptr_t)handle));
+  if (r == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}

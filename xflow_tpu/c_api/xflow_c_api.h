@@ -1,0 +1,40 @@
+/* C ABI for embedding xflow-tpu training in native applications.
+ *
+ * The reference's FFI surface (/root/reference/src/c_api/c_api.h:31-41:
+ * XFCreate constructs a worker, XFStartTrain runs it) is kept, extended
+ * with config overrides and result access. Thread-safety: calls must
+ * come from one thread (the embedded interpreter owns the GIL).
+ *
+ * Build the implementation with:
+ *   gcc -shared -fPIC xflow_c_api.c $(python3-config --includes) \
+ *       $(python3-config --ldflags --embed) -o libxflow_api.so
+ */
+
+#ifndef XFLOW_C_API_H_
+#define XFLOW_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Create a trainer for `train_prefix`/`test_prefix` shard sets
+ * (reads <prefix>-%05d). Returns 0 on success, nonzero on failure. */
+int XFCreate(void** out_handle, const char* train_prefix, const char* test_prefix);
+
+/* Apply a dotted config override, e.g. ("model.name", "fm"). */
+int XFSetConfig(void* handle, const char* dotted_key, const char* value);
+
+/* Run training (and evaluation when a test prefix was given). */
+int XFStartTrain(void* handle);
+
+/* Test AUC from the last XFStartTrain (NaN if not evaluated). */
+double XFGetAUC(void* handle);
+
+/* Release the trainer. */
+int XFDestroy(void* handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* XFLOW_C_API_H_ */

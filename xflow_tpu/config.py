@@ -124,6 +124,7 @@ class TrainConfig:
     log_every: int = 100
     checkpoint_dir: str = ""
     checkpoint_every: int = 0  # steps; 0 = only at end if dir set
+    checkpoint_format: str = "npz"  # "npz" (host-gathered) | "orbax" (sharded OCDBT)
     resume: bool = True
     pred_dump: bool = True  # write pred_<rank>_<block>.txt like lr_worker.cc:74-78
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)

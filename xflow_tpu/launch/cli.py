@@ -83,6 +83,7 @@ def cmd_train(args) -> int:
         "seconds": round(res.seconds, 3),
         "examples_per_sec": round(res.examples_per_sec, 1),
         "last_loss": res.last_loss,
+        "occupancy": res.occupancy,
     }
     # reference: only rank 0 runs predict (lr_worker.cc:211-215); here the
     # eval contains collectives, so every process participates and rank 0
@@ -126,6 +127,13 @@ def cmd_export(args) -> int:
     data = np.load(os.path.join(args.checkpoint_dir, f"step_{step}", "state.npz"))
     n = export_sparse_array(data[f"tables/{args.table}"], args.out)
     print(json.dumps({"step": step, "table": args.table, "nonzero": n}))
+    return 0
+
+
+def cmd_collisions(args) -> int:
+    from xflow_tpu.tools.collisions import measure
+
+    print(json.dumps(measure(args.paths, args.log2_slots, args.salt)))
     return 0
 
 
@@ -189,6 +197,12 @@ def main(argv=None) -> int:
     ex.add_argument("--table", default="w")
     ex.add_argument("--out", required=True)
     ex.set_defaults(fn=cmd_export)
+
+    co = sub.add_parser("collisions", help="measure feature-hash collision rate on libffm files")
+    co.add_argument("paths", nargs="+")
+    co.add_argument("--log2-slots", type=int, default=22)
+    co.add_argument("--salt", type=int, default=0)
+    co.set_defaults(fn=cmd_collisions)
 
     ll = sub.add_parser("launch-local", help="fork a local multi-process cluster (scripts/local.sh analog)")
     ll.add_argument("--num-processes", type=int, default=2)

@@ -1,0 +1,68 @@
+"""Hash-collision measurement.
+
+The reference accepts silent collisions from raw `std::hash` over the
+full 64-bit key space (`load_data_from_disk.cc:151`); this framework
+additionally folds keys into `2**log2_slots` dense slots, which adds
+collisions (SURVEY.md §7 hard part e: "match that behavior but measure
+collision rate"). This tool reports, for a dataset and slot budget:
+
+- distinct feature-id tokens seen
+- distinct 64-bit hashes (pre-fold collisions — FNV-1a birthday regime)
+- distinct slots (post-fold)
+- collision rate = 1 − distinct_slots / distinct_tokens
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from xflow_tpu.hashing import fnv1a64, slots_of
+
+
+def measure(paths: list[str], log2_slots: int, salt: int = 0) -> dict:
+    tokens: set[str] = set()
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t", 1)
+                if len(parts) < 2:
+                    parts = line.rstrip("\n").split(" ", 1)
+                    if len(parts) < 2:
+                        continue
+                for tok in parts[1].split():
+                    pieces = tok.split(":")
+                    if len(pieces) >= 2:
+                        tokens.add(pieces[1])
+    hashes = np.array([fnv1a64(t.encode(), salt) for t in tokens], dtype=np.uint64)
+    slots = slots_of(hashes, log2_slots)
+    n_tok = len(tokens)
+    n_hash = len(np.unique(hashes))
+    n_slot = len(np.unique(slots))
+    return {
+        "distinct_tokens": n_tok,
+        "distinct_hash64": n_hash,
+        "distinct_slots": n_slot,
+        "log2_slots": log2_slots,
+        "table_occupancy": n_slot / float(1 << log2_slots),
+        "collision_rate": 1.0 - (n_slot / n_tok) if n_tok else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="measure feature-hash collision rate")
+    ap.add_argument("paths", nargs="+")
+    ap.add_argument("--log2-slots", type=int, default=22)
+    ap.add_argument("--salt", type=int, default=0)
+    args = ap.parse_args(argv)
+    print(json.dumps(measure(args.paths, args.log2_slots, args.salt)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

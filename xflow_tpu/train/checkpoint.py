@@ -113,6 +113,53 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
     return TrainState(tables=tables, opt_state=opt_state, step=jnp.asarray(data["step"]))
 
 
+# --------------------------------------------------------------- orbax format
+#
+# The npz path above gathers the whole state to one host — fine for dev
+# scale, impossible for the north-star config (1B-feature FTRL state,
+# SURVEY.md §7 hard part d). The Orbax path saves each process's shards
+# directly (OCDBT), so no host ever materializes the full table, and
+# restore places shards straight onto the target sharding.
+
+def save_orbax(ckpt_dir: str, state: TrainState) -> str:
+    import orbax.checkpoint as ocp
+
+    step = int(state.step)
+    path = os.path.abspath(os.path.join(ckpt_dir, f"orbax_step_{step}"))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state._asdict(), force=True)
+    return path
+
+
+def latest_orbax_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = re.match(r"^orbax_step_(\d+)$", name)
+        if m:
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> TrainState:
+    """Restore with `like`'s shardings (shards load directly per process)."""
+    import orbax.checkpoint as ocp
+
+    step = latest_orbax_step(ckpt_dir) if step is None else step
+    if step is None:
+        raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir}")
+    path = os.path.abspath(os.path.join(ckpt_dir, f"orbax_step_{step}"))
+
+    def as_abstract(x):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+
+    abstract = jax.tree.map(as_abstract, like._asdict())
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+    return TrainState(**restored)
+
+
 def export_sparse_array(w: np.ndarray, out_path: str) -> int:
     """Dump nonzero rows of a weight array as `slot\\tweight...` text."""
     w = np.asarray(w)

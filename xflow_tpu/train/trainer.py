@@ -41,6 +41,7 @@ class TrainResult:
     last_loss: float = float("nan")
     auc: float = float("nan")
     logloss: float = float("nan")
+    occupancy: dict = field(default_factory=dict)
 
     @property
     def examples_per_sec(self) -> float:
@@ -186,6 +187,12 @@ class Trainer:
             if cfg.train.profile_dir:
                 jax.profiler.stop_trace()
         res.seconds = time.time() - start
+        # table occupancy: fraction of slots FTRL has left nonzero — the
+        # sparse-model health metric (SURVEY.md §5 "table-occupancy")
+        for name, t in self.state.tables.items():
+            nz = jnp.mean((jnp.abs(t) > 0).any(axis=-1) if t.ndim > 1 else (t != 0))
+            res.occupancy[name] = float(nz)
+        self.metrics.log({"final": True, "steps": res.steps, "occupancy": res.occupancy})
         if cfg.train.checkpoint_dir:
             self.save_checkpoint()
         return res
@@ -238,18 +245,27 @@ class Trainer:
 
     # ------------------------------------------------------------- checkpoint
     def save_checkpoint(self) -> None:
-        from xflow_tpu.train.checkpoint import save
+        from xflow_tpu.train import checkpoint as ckpt
 
-        save(self.cfg.train.checkpoint_dir, self.state)
+        if self.cfg.train.checkpoint_format == "orbax":
+            ckpt.save_orbax(self.cfg.train.checkpoint_dir, self.state)
+        else:
+            ckpt.save(self.cfg.train.checkpoint_dir, self.state)
 
     def maybe_restore(self) -> bool:
-        from xflow_tpu.train.checkpoint import latest_step, restore
+        from xflow_tpu.train import checkpoint as ckpt
 
         if not (self.cfg.train.checkpoint_dir and self.cfg.train.resume):
             return False
-        if latest_step(self.cfg.train.checkpoint_dir) is None:
-            return False
-        self.state = restore(self.cfg.train.checkpoint_dir, self.state)
+        cdir = self.cfg.train.checkpoint_dir
+        if self.cfg.train.checkpoint_format == "orbax":
+            if ckpt.latest_orbax_step(cdir) is None:
+                return False
+            self.state = ckpt.restore_orbax(cdir, self.state)
+        else:
+            if ckpt.latest_step(cdir) is None:
+                return False
+            self.state = ckpt.restore(cdir, self.state)
         return True
 
 
