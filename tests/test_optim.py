@@ -41,6 +41,29 @@ def test_ftrl_zero_gradient_is_noop():
     np.testing.assert_allclose(np.asarray(s2["w"]["z"]), np.asarray(state["w"]["z"]))
 
 
+def test_ftrl_zero_push_on_fresh_random_table_is_noop():
+    # Lazy-init parity (ADVICE r1, ftrl.h:113-120): a slot that has NEVER
+    # received a gradient must keep its build-time random init — the
+    # reference only constructs entries on first push, so untouched v-table
+    # rows stay at their ~N(0,1)*1e-2 init. A dense z→w recompute would
+    # zero them on step 1.
+    opt = get_optimizer("ftrl")
+    rng = np.random.default_rng(3)
+    w0 = rng.normal(size=(N,)).astype(np.float32) * 1e-2
+    tables = {"v": jnp.asarray(w0)}
+    state = opt.init_state(tables)
+    t2, s2 = opt.apply(tables, state, {"v": jnp.zeros((N,))}, CFG)
+    np.testing.assert_array_equal(np.asarray(t2["v"]), w0)
+    np.testing.assert_array_equal(np.asarray(s2["v"]["n"]), np.zeros((N,)))
+    np.testing.assert_array_equal(np.asarray(s2["v"]["z"]), np.zeros((N,)))
+    # and a partial push only touches the pushed slots
+    g = np.zeros((N,), np.float32)
+    g[:4] = 1.0
+    t3, _ = opt.apply(tables, state, {"v": jnp.asarray(g)}, CFG)
+    np.testing.assert_array_equal(np.asarray(t3["v"][4:]), w0[4:])
+    assert not np.array_equal(np.asarray(t3["v"][:4]), w0[:4])
+
+
 def test_ftrl_sparsity():
     # tiny gradients must leave w exactly 0 (soft threshold λ1)
     opt = get_optimizer("ftrl")

@@ -55,7 +55,9 @@ def init_tables(model: Model, cfg: Config, key: jax.Array) -> Dict[str, jax.Arra
     `ftrl.h:27-36`). v-tables init ~N(0,1)*v_init_scale for FTRL
     (`ftrl.h:117`) or constant v_init_sgd for SGD (`sgd.h:69`) — the
     reference does this lazily per touched key; dense pre-init is
-    equivalent because untouched slots are never read meaningfully.
+    equivalent because the FTRL update preserves never-touched slots
+    (g=0 ∧ n=0 keeps w, see `optim/ftrl.py:_update_one`) and SGD with
+    g=0 is a no-op.
     """
     tables = {}
     specs = model.table_specs(cfg)

@@ -33,6 +33,18 @@ def _update_one(w, n, z, g, alpha, beta, lambda1, lambda2):
     shrink = jnp.sign(z_new) * lambda1
     denom = (beta + jnp.sqrt(n_new)) / alpha + lambda2
     w_new = jnp.where(jnp.abs(z_new) <= lambda1, 0.0, -(z_new - shrink) / denom)
+    # Lazy-init parity (`ftrl.h:113-120`): the reference only creates an
+    # entry when a key is first pushed, so a never-touched slot keeps its
+    # random v-table init. A dense recompute of w from z would zero every
+    # untouched slot (z=0 ⇒ w=0) on step 1, wiping the v init and stalling
+    # FM/MVM second-order terms. Keep w unchanged where the slot has never
+    # seen a gradient (g=0 this step AND n=0 from all prior steps).
+    # Edge divergence vs the reference (documented in docs/PARITY.md C11):
+    # a key whose first-ever push is exactly g=0 would have its w zeroed
+    # by the reference; the dense form can't see the key list and keeps
+    # the init.
+    untouched = (g == 0.0) & (n == 0.0)
+    w_new = jnp.where(untouched, w, w_new)
     return w_new, n_new, z_new
 
 

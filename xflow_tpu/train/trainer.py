@@ -187,11 +187,22 @@ class Trainer:
             if cfg.train.profile_dir:
                 jax.profiler.stop_trace()
         res.seconds = time.time() - start
-        # table occupancy: fraction of slots FTRL has left nonzero — the
-        # sparse-model health metric (SURVEY.md §5 "table-occupancy")
+        # table occupancy: fraction of slots ever touched by a gradient —
+        # the sparse-model health metric (SURVEY.md §5 "table-occupancy").
+        # FTRL's n accumulator (n>0 ⇔ slot was pushed) is the reliable
+        # signal; untouched slots keep their build-time init, so a
+        # nonzero count would read ~1.0 for randomly-initialized v tables.
         for name, t in self.state.tables.items():
-            nz = jnp.mean((jnp.abs(t) > 0).any(axis=-1) if t.ndim > 1 else (t != 0))
-            res.occupancy[name] = float(nz)
+            st = self.state.opt_state.get(name)
+            if isinstance(st, dict) and "n" in st:
+                touched = (st["n"] > 0).any(axis=-1) if st["n"].ndim > 1 else st["n"] > 0
+            else:
+                # stateless optimizer (SGD): a touched slot has moved off
+                # its build-time init (0 for scalar tables, v_init_sgd for
+                # vector tables — models/base.py init_tables)
+                init = cfg.optim.v_init_sgd if t.ndim > 1 else 0.0
+                touched = (t != init).any(axis=-1) if t.ndim > 1 else t != init
+            res.occupancy[name] = float(jnp.mean(touched))
         self.metrics.log({"final": True, "steps": res.steps, "occupancy": res.occupancy})
         if cfg.train.checkpoint_dir:
             self.save_checkpoint()
