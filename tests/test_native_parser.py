@@ -128,6 +128,55 @@ def test_edge_case_parity_with_python(tmp_path):
     assert py[0].labels[4] == 1.0  # 0.5 > 1e-7
 
 
+def test_junk_fgid_parity_with_python(tmp_path):
+    # a non-numeric / partially-numeric field id must parse identically in
+    # both paths (strtod semantics: longest numeric prefix, 0 for junk) —
+    # round-1 divergence: the Python path crashed on these
+    p = tmp_path / "junk-00000"
+    p.write_text(
+        "1\tabc:77:1\n"       # junk fgid -> 0
+        "0\t3x:12:1\n"        # numeric prefix -> 3
+        "1\t2.9:13:1\n"       # fractional -> int(2.9) = 2
+        "0\t-1e1:14:1 :15:1\n"  # scientific -> -10; empty fgid -> 0
+        "1\tinf:16:1 nan:17:1\n"   # strtod parses these; i32: saturate / 0
+        "0\t1e300:18:1 -inf:19:1\n"  # overflow saturation both signs
+        "1\t0x10:20:1 1_0:21:1\n"  # C99 hex float -> 16; '_' stops strtod -> 1
+    )
+    cfg = DataConfig(log2_slots=12, max_nnz=4)
+    py = _batches_python(str(p), cfg, 8)
+    nat = _batches_native(str(p), cfg, 8)
+    assert len(py) == len(nat) == 1
+    for a, b in zip(py, nat):
+        np.testing.assert_array_equal(a.fields, b.fields)
+        np.testing.assert_array_equal(a.slots, b.slots)
+        np.testing.assert_array_equal(a.mask, b.mask)
+    assert py[0].fields[0, 0] == 0
+    assert py[0].fields[1, 0] == 3
+    assert py[0].fields[2, 0] == 2
+    assert py[0].fields[3, 0] == -10 and py[0].fields[3, 1] == 0
+    assert py[0].fields[4, 0] == 2**31 - 1 and py[0].fields[4, 1] == 0
+    assert py[0].fields[5, 0] == 2**31 - 1 and py[0].fields[5, 1] == -(2**31)
+    assert py[0].fields[6, 0] == 16 and py[0].fields[6, 1] == 1
+
+
+def test_count_rows_parity(tmp_path):
+    from xflow_tpu.data.libffm import count_rows
+    from xflow_tpu.data.pipeline import count_batches
+
+    native = _native()
+    path = generate_shards(str(tmp_path / "s"), 1, 123, num_fields=5, ids_per_field=40, seed=8)[0]
+    with open(path, "a") as f:
+        f.write("\n\n1\tfoo\nbare_token\n0.5\t0:1:1")  # blanks / no-sep lines
+    expected = 123 + 2  # "1\tfoo" and the final unterminated line count
+    assert count_rows(path) == expected
+    assert native.native_count_rows(path, 1 << 20) == expected
+    # batch math incl. remainder handling
+    cfg = DataConfig(log2_slots=12, max_nnz=8)
+    assert count_batches(path, cfg, 32) == -(-expected // 32)
+    assert len(_batches_native(path, cfg, 32)) == count_batches(path, cfg, 32)
+    assert len(_batches_python(path, cfg, 32)) == count_batches(path, cfg, 32)
+
+
 def test_missing_file_raises_eagerly():
     native = _native()
     with pytest.raises(FileNotFoundError):

@@ -24,11 +24,50 @@ The reference's per-rank shard convention ``"%s-%05d" % (prefix, rank)``
 from __future__ import annotations
 
 import os
+import re
 from typing import Iterator, Optional
 
 import numpy as np
 
 from xflow_tpu.hashing import fnv1a64, slot_of
+
+_NUM_PREFIX = re.compile(r"^[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
+_HEX_PREFIX = re.compile(r"^[+-]?0[xX][0-9a-fA-F]+(?:\.[0-9a-fA-F]*)?(?:[pP][+-]?\d+)?")
+
+
+def _strtod(tok: str) -> float:
+    """C strtod semantics: parse the longest numeric prefix, 0.0 for junk.
+
+    The native parser uses strtod for labels and field ids
+    (`native/parser.cc`); the Python path must parse the same file to the
+    same batches (round-1 divergence: `int(float(...))` raised on junk
+    fgids while the native path yielded 0 and continued). Covers the
+    strtod corners Python's float() handles differently: hex floats
+    (C99, float() rejects) and underscore digit groups (float() accepts,
+    strtod stops at the underscore)."""
+    tok = tok.strip()
+    if "_" not in tok:
+        try:
+            return float(tok)  # fast path; also covers inf/nan like strtod
+        except ValueError:
+            pass
+    m = _HEX_PREFIX.match(tok)
+    if m:
+        return float.fromhex(m.group(0))
+    m = _NUM_PREFIX.match(tok)
+    return float(m.group(0)) if m else 0.0
+
+
+def _fgid_i32(x: float) -> int:
+    """Field id as int32 with explicit nan→0 and saturation — the defined
+    semantics both parsers implement (a raw C cast would be UB here)."""
+    if x != x:
+        return 0
+    if x >= 2147483647.0:
+        return 2147483647
+    if x <= -2147483648.0:
+        return -2147483648
+    return int(x)
 
 
 def shard_path(prefix: str, rank: int) -> str:
@@ -49,18 +88,14 @@ def parse_line(
         parts = line.split(" ", 1)
         if len(parts) == 1:
             return None
-    try:
-        label_val = float(parts[0])
-    except ValueError:
-        label_val = 0.0  # reference uses atof, which yields 0 for junk
-    label = 1.0 if label_val > 1e-7 else 0.0
+    label = 1.0 if _strtod(parts[0]) > 1e-7 else 0.0
     fields = []
     slots = []
     for tok in parts[1].split():
         pieces = tok.split(":")
         if len(pieces) < 2:
             continue
-        fields.append(int(float(pieces[0])))
+        fields.append(_fgid_i32(_strtod(pieces[0])))
         slots.append(slot_of(fnv1a64(pieces[1].encode("utf-8"), salt), log2_slots))
     return (
         label,
@@ -84,6 +119,19 @@ def read_examples(
     path: str, log2_slots: int, salt: int = 0
 ) -> list[tuple[float, np.ndarray, np.ndarray]]:
     return list(iter_examples(path, log2_slots, salt))
+
+
+def count_rows(path: str) -> int:
+    """Count the examples `iter_examples` would yield, without parsing
+    tokens — `parse_line` yields a row iff the stripped line still
+    contains a label separator (tab or space)."""
+    n = 0
+    with open(path, "r") as f:
+        for line in f:
+            s = line.strip()
+            if s and ("\t" in s or " " in s):
+                n += 1
+    return n
 
 
 def available_shards(prefix: str) -> list[str]:

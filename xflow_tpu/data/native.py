@@ -70,8 +70,21 @@ def get_lib() -> ctypes.CDLL:
         lib.xf_parser_truncated.argtypes = [ctypes.c_void_p]
         lib.xf_parser_close.restype = None
         lib.xf_parser_close.argtypes = [ctypes.c_void_p]
+        lib.xf_count_rows.restype = ctypes.c_long
+        lib.xf_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_long]
         _LIB = lib
     return _LIB
+
+
+def native_count_rows(path: str, block_bytes: int) -> int:
+    """Rows the native parser would produce for `path` (same predicate,
+    no token parsing); raises on missing file / read error."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    n = int(get_lib().xf_count_rows(path.encode(), block_bytes))
+    if n < 0:
+        raise OSError(f"xf_count_rows failed for {path}")
+    return n
 
 
 def native_hash(token: bytes, salt: int = 0) -> int:

@@ -77,6 +77,32 @@ def batch_iterator(
     )
 
 
+def count_batches(path: str, cfg: DataConfig, batch_size: Optional[int] = None) -> int:
+    """Number of batches `batch_iterator` will yield for `path`.
+
+    Uses the row counter matching the parser that will actually run
+    (native predicate for the native path, parse_line predicate for the
+    Python path) so multi-process step coordination can be computed with
+    ONE collective per epoch instead of one allgather per step.
+    """
+    bs = batch_size or cfg.batch_size
+    rows = None
+    if cfg.use_native_parser:
+        try:
+            from xflow_tpu.data.native import native_count_rows
+
+            rows = native_count_rows(path, cfg.block_bytes)
+        except FileNotFoundError:
+            raise
+        except (ImportError, OSError, RuntimeError, subprocess.SubprocessError):
+            rows = None  # toolchain missing: the Python parser will run
+    if rows is None:
+        from xflow_tpu.data.libffm import count_rows
+
+        rows = count_rows(path)
+    return rows // bs if cfg.drop_remainder else -(-rows // bs)
+
+
 def prefetch(iterator: Iterator[SparseBatch], depth: int = 2) -> Iterator[SparseBatch]:
     """Run the parse/batch pipeline in a background thread with a bounded queue."""
     q: queue.Queue = queue.Queue(maxsize=depth)

@@ -37,7 +37,14 @@ def launch_local(num_processes: int, forward_args: list[str], port: int = 0) -> 
             XFLOW_COORDINATOR=coordinator,
             XFLOW_NUM_PROCESSES=str(num_processes),
             XFLOW_PROCESS_ID=str(rank),
-            JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+            # Children MUST default to CPU: inheriting an ambient
+            # accelerator platform would land every child on the same
+            # device (this image pins one TPU), the world would never
+            # form, and each child would silently train shard 0 as its
+            # own rank 0. Real multi-host accelerator launches opt in
+            # via XFLOW_LAUNCH_PLATFORM; parallel/distributed.py's
+            # process-count assert catches any remaining mismatch.
+            JAX_PLATFORMS=env.get("XFLOW_LAUNCH_PLATFORM", "cpu"),
         )
         cmd = [sys.executable, "-m", "xflow_tpu", "train", *forward_args]
         procs.append(subprocess.Popen(cmd, env=env))

@@ -55,6 +55,16 @@ inline uint64_t mix64(uint64_t x) {
   return x;
 }
 
+// Field id as int32 with explicit nan→0 and saturation: a raw
+// static_cast from an out-of-range double is UB, and the Python path
+// (data/libffm.py _fgid_i32) implements these exact semantics.
+inline int32_t fgid_i32(double d) {
+  if (d != d) return 0;
+  if (d >= 2147483647.0) return 2147483647;
+  if (d <= -2147483648.0) return INT32_MIN;
+  return static_cast<int32_t>(d);
+}
+
 struct Parser {
   FILE* fp = nullptr;
   std::vector<char> buf;
@@ -99,7 +109,12 @@ struct Parser {
       end += got;
       if (got == 0) {
         eof = true;
-        if (ferror(fp)) error = true;  // I/O fault, not end-of-data
+        if (ferror(fp)) {
+          // I/O fault, not end-of-data: discard the buffered partial tail
+          // immediately so no data from a failed read ever reaches a batch
+          error = true;
+          return nullptr;
+        }
       }
     }
   }
@@ -179,7 +194,7 @@ long xf_parser_next_batch(void* handle, long batch_size, long max_nnz,
             memchr(c1 + 1, ':', static_cast<size_t>(tok_end - c1 - 1)));
         const char* fid_end = (c2 != nullptr) ? c2 : tok_end;
         if (nnz < max_nnz) {
-          frow[nnz] = static_cast<int32_t>(strtod(cur, nullptr));
+          frow[nnz] = fgid_i32(strtod(cur, nullptr));
           uint64_t key =
               fnv1a64(c1 + 1, static_cast<size_t>(fid_end - c1 - 1), salt);
           srow[nnz] = static_cast<int32_t>(mix64(key) &
@@ -204,6 +219,34 @@ void xf_parser_close(void* handle) {
   Parser* p = static_cast<Parser*>(handle);
   if (p->fp != nullptr) fclose(p->fp);
   delete p;
+}
+
+// Count the rows xf_parser_next_batch would produce for this file — the
+// EXACT same line predicate (CR-stripped non-empty line containing a
+// label separator), no hashing or token parsing. Used to precompute
+// per-epoch batch counts so multi-process training needs ONE collective
+// per epoch instead of one per step. Returns -1 on open/read failure.
+long xf_count_rows(const char* path, long block_bytes) {
+  void* handle = xf_parser_open(path, block_bytes);
+  if (handle == nullptr) return -1;
+  Parser* p = static_cast<Parser*>(handle);
+  long rows = 0;
+  size_t len = 0;
+  for (;;) {
+    const char* line = p->next_line(&len);
+    if (line == nullptr) break;
+    while (len > 0 && (line[len - 1] == '\r')) --len;
+    if (len == 0) continue;
+    if (memchr(line, '\t', len) != nullptr || memchr(line, ' ', len) != nullptr) {
+      // separator must come before the end: matches the batch parser's
+      // "label token ends before lend" check because memchr can only
+      // find it at index < len
+      ++rows;
+    }
+  }
+  bool err = p->error;
+  xf_parser_close(handle);
+  return err ? -1 : rows;
 }
 
 }  // extern "C"

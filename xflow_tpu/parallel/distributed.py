@@ -42,5 +42,16 @@ def maybe_initialize(
             num_processes=num_processes,
             process_id=process_id,
         )
+        # Loud world-formation check: if the backend ignored the distributed
+        # config (e.g. every process initialized on the same ambient
+        # accelerator), each process would silently run as its own rank 0
+        # and train shard 0 N times. Fail instead.
+        if jax.process_count() != num_processes:
+            raise RuntimeError(
+                f"distributed world failed to form: jax.process_count()="
+                f"{jax.process_count()} != num_processes={num_processes} "
+                f"(platform={jax.default_backend()!r}; on a single-accelerator "
+                "host launch with JAX_PLATFORMS=cpu)"
+            )
         return jax.process_index()
     return 0

@@ -71,6 +71,15 @@ def state_shardings(state, mesh: Mesh):
 
     def spec(leaf):
         if getattr(leaf, "ndim", 0) >= 1:
+            n = getattr(leaf, "shape", (0,))[0]
+            if n % mesh.size != 0:
+                # fail here with a framework message instead of deep
+                # inside XLA partitioning
+                raise ValueError(
+                    f"table slot count {n} is not divisible by the mesh size "
+                    f"{mesh.size} ({dict(mesh.shape)}); pick data.log2_slots "
+                    "so 2^log2_slots is a multiple of data*table"
+                )
             return table_sharding(mesh, leaf.ndim)
         return replicated(mesh)
 

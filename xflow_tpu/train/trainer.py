@@ -24,7 +24,7 @@ import numpy as np
 
 from xflow_tpu.config import Config
 from xflow_tpu.data.libffm import shard_path
-from xflow_tpu.data.pipeline import batch_iterator, prefetch
+from xflow_tpu.data.pipeline import batch_iterator, count_batches, prefetch
 from xflow_tpu.metrics import auc_logloss
 from xflow_tpu.models import get_model
 from xflow_tpu.optim import get_optimizer
@@ -100,45 +100,76 @@ class Trainer:
                 )
 
     # -------------------------------------------------------- multi-process IO
-    def _coordinated_batches(self, iterator):
-        """Yield local batches, padding with empty ones until every process's
-        input is exhausted.
-
-        SPMD steps are collective: if process A has 10 batches and process B
-        has 9 (ragged shards — the reference tolerates this because its
-        workers never synchronize), B would deadlock A. Each step the
-        processes agree (tiny allgather) whether anyone still has data;
-        exhausted ranks contribute fully-masked empty batches.
-        """
-        if jax.process_count() == 1:
-            yield from iterator
-            return
-        from jax.experimental import multihost_utils
-
+    def _empty_batch(self):
         from xflow_tpu.data.schema import SparseBatch
 
-        cfg = self.cfg.data
-        it = iter(iterator)
-        while True:
-            try:
-                batch = next(it)
-                have = np.int32(1)
-            except (StopIteration, FileNotFoundError):
-                batch, have = None, np.int32(0)
-                it = iter(())  # a missing local shard counts as exhausted
-            counts = np.asarray(multihost_utils.process_allgather(have))
-            if counts.max() == 0:
-                return
+        B, F = self.cfg.data.batch_size, self.cfg.data.max_nnz
+        return SparseBatch(
+            slots=np.zeros((B, F), np.int32),
+            fields=np.zeros((B, F), np.int32),
+            mask=np.zeros((B, F), np.float32),
+            labels=np.zeros((B,), np.float32),
+            row_mask=np.zeros((B,), np.float32),
+        )
+
+    def _global_batch_count(self, path: str) -> tuple[int, int]:
+        """(global_steps, local_batches) for one pass over `path`.
+
+        SPMD steps are collective: if process A has 10 batches and process
+        B has 9 (ragged shards — the reference tolerates this because its
+        async workers never synchronize), B would deadlock A. Instead of
+        a per-step host allgather (which dominates at µs-scale step times,
+        round-1 weak #5), each process counts its local batches with the
+        parser-matched row counter, and ONE allgather per (path, pass)
+        fixes the global step count = max over processes. Re-counted every
+        pass (not cached) so shards that appear, grow, or shrink between
+        epochs are picked up. A missing local shard counts as 0 batches
+        (reference: rank k simply finds no `<prefix>-%05d` file and its
+        workers idle).
+        """
+        try:
+            local = count_batches(path, self.cfg.data)
+        except FileNotFoundError:
+            local = 0
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
+        return int(counts.max()), local
+
+    def _coordinated_batches(self, path: str):
+        """Yield exactly the globally-agreed number of batches for `path`,
+        padding with fully-masked empty batches once local input is
+        exhausted. Collective-free on the host side after the one counting
+        allgather (cached across epochs)."""
+        if jax.process_count() == 1:
+            yield from prefetch(batch_iterator(path, self.cfg.data))
+            return
+        global_steps, local = self._global_batch_count(path)
+        # open the real iterator whenever the file exists (even if counted
+        # 0) so the drift check below can catch a counter that under-reads
+        it = (
+            iter(prefetch(batch_iterator(path, self.cfg.data)))
+            if os.path.exists(path)
+            else iter(())
+        )
+        produced = 0
+        for _ in range(global_steps):
+            batch = next(it, None)
             if batch is None:
-                B, F = cfg.batch_size, cfg.max_nnz
-                batch = SparseBatch(
-                    slots=np.zeros((B, F), np.int32),
-                    fields=np.zeros((B, F), np.int32),
-                    mask=np.zeros((B, F), np.float32),
-                    labels=np.zeros((B,), np.float32),
-                    row_mask=np.zeros((B,), np.float32),
-                )
+                batch = self._empty_batch()
+            else:
+                produced += 1
             yield batch
+        # loud drift check: if the counter mispredicted, data would be
+        # silently dropped (under-count) or phantom empty steps run
+        # (over-count) — either means the counter/parser predicates split
+        if next(it, None) is not None or produced != local:
+            raise RuntimeError(
+                f"batch count drift on {path!r}: counted {local}, parser "
+                f"produced {produced}{'+' if produced == local else ''} — "
+                "the file changed while this pass was reading it, or the "
+                "row-counter and parser predicates disagree (bug)"
+            )
 
     # ------------------------------------------------------------------ train
     def fit(self, train_path: Optional[str] = None) -> TrainResult:
@@ -148,14 +179,14 @@ class Trainer:
         start = time.time()
         if cfg.train.profile_dir:
             jax.profiler.start_trace(cfg.train.profile_dir)
+        last_metrics = None
         try:
             for epoch in range(cfg.train.epochs):
-                for batch in self._coordinated_batches(
-                    prefetch(batch_iterator(path, cfg.data))
-                ):
+                for batch in self._coordinated_batches(path):
                     self._check_batch(batch)
                     arrays = self._shard_batch(batch_to_arrays(batch))
                     self.state, m = self.train_step(self.state, arrays)
+                    last_metrics = m
                     res.steps += 1
                     res.examples += batch.num_rows
                     if cfg.train.log_every and res.steps % cfg.train.log_every == 0:
@@ -181,8 +212,8 @@ class Trainer:
                 if cfg.train.eval_every and (epoch + 1) % cfg.train.eval_every == 0:
                     auc, ll = self.evaluate(dump=False)
                     self.metrics.log({"epoch": epoch, "eval_auc": auc, "eval_logloss": ll})
-            if "m" in dir():
-                res.last_loss = float(m["loss"])
+            if last_metrics is not None:
+                res.last_loss = float(last_metrics["loss"])
         finally:
             if cfg.train.profile_dir:
                 jax.profiler.stop_trace()
@@ -220,7 +251,7 @@ class Trainer:
         dump = dump and (not multiproc or self.rank == 0)
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
-        for batch in self._coordinated_batches(prefetch(batch_iterator(path, cfg.data))):
+        for batch in self._coordinated_batches(path):
             self._check_batch(batch)
             arrays = self._shard_batch(batch_to_arrays(batch))
             p_dev = self.eval_step(self.state.tables, arrays)
