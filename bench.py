@@ -35,7 +35,7 @@ def main() -> int:
     ap.add_argument("--log2-slots", type=int, default=22)
     ap.add_argument("--scan-steps", type=int, default=32, help="train steps per compiled program")
     ap.add_argument("--repeats", type=int, default=5)
-    ap.add_argument("--model", default="lr")
+    ap.add_argument("--model", default="all", help="lr|fm|mvm|all (all = one JSON line, LR headline)")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes for CI")
     args = ap.parse_args()
     if args.smoke:
@@ -58,66 +58,73 @@ def main() -> int:
     from xflow_tpu.train.state import init_state
     from xflow_tpu.train.step import make_train_step
 
-    cfg = override(
-        Config(),
-        **{
-            "model.name": args.model,
-            "data.log2_slots": args.log2_slots,
-            "data.max_nnz": args.nnz,
-            "data.batch_size": args.batch,
-        },
-    )
-    model, opt = get_model(args.model), get_optimizer("ftrl")
-    state = init_state(model, opt, cfg)
-    step = make_train_step(model, opt, cfg, jit=False)
-
     K, B, F = args.scan_steps, args.batch, args.nnz
     rng = np.random.default_rng(0)
-    batches = {
-        "slots": jnp.asarray(rng.integers(0, cfg.num_slots, (K, B, F)), jnp.int32),
-        "fields": jnp.asarray(rng.integers(0, cfg.model.num_fields, (K, B, F)), jnp.int32),
-        "mask": jnp.asarray((rng.random((K, B, F)) < 0.6).astype(np.float32)),
-        "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
-        "row_mask": jnp.ones((K, B), jnp.float32),
-    }
 
-    @jax.jit
-    def run_k_steps(state, batches):
-        def body(st, batch):
-            st, m = step(st, batch)
-            return st, m["loss"]
-
-        return jax.lax.scan(body, state, batches)
-
-    # warmup / compile
-    state, losses = run_k_steps(state, batches)
-    _ = float(losses[-1])  # host read = hard sync
-
-    times = []
-    for _ in range(args.repeats):
-        t0 = time.perf_counter()
-        state, losses = run_k_steps(state, batches)
-        _ = float(losses[-1])
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-
-    ex_per_sec = K * B / best
-    print(
-        json.dumps(
-            {
-                "metric": f"{args.model}_examples_per_sec",
-                "value": round(ex_per_sec, 1),
-                "unit": "examples/sec",
-                "vs_baseline": round(ex_per_sec / PER_CHIP_TARGET, 3),
-            }
+    def bench_model(name: str) -> float:
+        cfg = override(
+            Config(),
+            **{
+                "model.name": name,
+                "data.log2_slots": args.log2_slots,
+                "data.max_nnz": args.nnz,
+                "data.batch_size": args.batch,
+            },
         )
-    )
-    print(
-        f"# device={jax.devices()[0]} scan_steps={K} batch={B} nnz={F} "
-        f"slots=2^{args.log2_slots} best={best*1e3:.1f}ms/{K}steps "
-        f"({best/K*1e6:.0f}µs/step) times_ms={[round(t*1e3,1) for t in times]}",
-        file=sys.stderr,
-    )
+        model, opt = get_model(name), get_optimizer("ftrl")
+        state = init_state(model, opt, cfg)
+        step = make_train_step(model, opt, cfg, jit=False)
+        batches = {
+            "slots": jnp.asarray(rng.integers(0, cfg.num_slots, (K, B, F)), jnp.int32),
+            "fields": jnp.asarray(rng.integers(0, cfg.model.num_fields, (K, B, F)), jnp.int32),
+            "mask": jnp.asarray((rng.random((K, B, F)) < 0.6).astype(np.float32)),
+            "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
+            "row_mask": jnp.ones((K, B), jnp.float32),
+        }
+
+        @jax.jit
+        def run_k_steps(state, batches):
+            def body(st, batch):
+                st, m = step(st, batch)
+                return st, m["loss"]
+
+            return jax.lax.scan(body, state, batches)
+
+        # warmup / compile
+        state, losses = run_k_steps(state, batches)
+        _ = float(losses[-1])  # host read = hard sync
+
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            state, losses = run_k_steps(state, batches)
+            _ = float(losses[-1])
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        print(
+            f"# {name}: device={jax.devices()[0]} scan_steps={K} batch={B} nnz={F} "
+            f"slots=2^{args.log2_slots} best={best*1e3:.1f}ms/{K}steps "
+            f"({best/K*1e6:.0f}µs/step) times_ms={[round(t*1e3,1) for t in times]}",
+            file=sys.stderr,
+        )
+        return K * B / best
+
+    models = ["lr", "fm", "mvm"] if args.model == "all" else [args.model]
+    rates = {name: bench_model(name) for name in models}
+    headline = "lr" if "lr" in rates else models[0]
+    record = {
+        "metric": f"{headline}_examples_per_sec",
+        "value": round(rates[headline], 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(rates[headline] / PER_CHIP_TARGET, 3),
+    }
+    # secondary models ride along in the same single JSON line so FM/MVM
+    # regressions are visible in BENCH_r*.json (round-1 verdict item 3)
+    for name in models:
+        if name != headline:
+            record[f"{name}_examples_per_sec"] = round(rates[name], 1)
+            record[f"{name}_vs_baseline"] = round(rates[name] / PER_CHIP_TARGET, 3)
+    print(json.dumps(record))
     return 0
 
 

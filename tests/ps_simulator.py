@@ -1,0 +1,150 @@
+"""NumPy simulation of the reference's parameter-server training loop.
+
+Reproduces the *semantics* of the reference's per-thread
+Pull -> compute -> Push cycle against server-side FTRL state
+(`/root/reference/src/model/lr/lr_worker.cc:145-177` +
+`/root/reference/src/optimizer/ftrl.h:58-74,98-152`), in plain NumPy
+with a deterministic (single-worker) schedule:
+
+- per minibatch: collect per-occurrence keys, dedup (`lr_worker.cc:150-165`),
+  pull w for unique keys (lazy server entries), compute the model forward,
+  accumulate per-key gradients divided by the minibatch row count
+  (`lr_worker.cc:116-118`), push; the server applies FTRL per key.
+- v-table entries lazily init ~N(0,1)*1e-2 on first touch (`ftrl.h:113-120`).
+- FM uses the reference's *coupled* second-order form and its hand-written
+  gradients: the w-gradient is accumulated once per latent dim (so scaled
+  by k, `fm_worker.cc:134-148`), v-gradient = loss*(v_sum - v_i)
+  (`fm_worker.cc:140-142`).
+
+This is the oracle for the async->sync semantic-shift gate
+(BASELINE.md config 1): the framework's synchronous SPMD training must
+reach the same AUC (within epsilon) as this faithful re-creation of the
+reference's training loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ALPHA, BETA, L1, L2 = 5e-2, 1.0, 5e-5, 10.0  # ftrl.h:17-20
+
+
+def _sigmoid_ref(x: float) -> float:
+    # reference sigmoid with +-30 clamp (base.h:54-63)
+    x = min(30.0, max(-30.0, x))
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class FTRLTable:
+    """Server-side per-key FTRL state (ftrl.h): dict key -> (w, n, z)."""
+
+    def __init__(self, dim: int = 0, rng: np.random.Generator | None = None,
+                 init_scale: float = 1e-2):
+        self.dim = dim  # 0 = scalar w-table; >0 = v-table rows
+        self.rng = rng
+        self.init_scale = init_scale
+        self.store: dict[int, list[np.ndarray]] = {}
+
+    def _entry(self, key: int):
+        e = self.store.get(key)
+        if e is None:
+            if self.dim:
+                # lazy random init on first touch (ftrl.h:113-120)
+                w = self.rng.normal(0.0, 1.0, self.dim) * self.init_scale
+            else:
+                w = np.zeros(1)
+            e = [w.astype(np.float64), np.zeros_like(w), np.zeros_like(w)]
+            self.store[key] = e
+        return e
+
+    def pull(self, keys):
+        return np.stack([self._entry(k)[0] for k in keys])
+
+    def push(self, keys, grads):
+        # ftrl.h:58-74 per element
+        for k, g in zip(keys, grads):
+            w, n, z = self._entry(k)
+            g = np.atleast_1d(np.asarray(g, np.float64))
+            n_new = n + g * g
+            z += g - (np.sqrt(n_new) - np.sqrt(n)) / ALPHA * w
+            n[:] = n_new
+            w[:] = np.where(
+                np.abs(z) <= L1,
+                0.0,
+                -(z - np.sign(z) * L1) / ((BETA + np.sqrt(n)) / ALPHA + L2),
+            )
+
+
+def sim_train_lr(batches, epochs: int) -> FTRLTable:
+    """batches: list of (labels [B], rows: list of per-row key arrays)."""
+    table = FTRLTable()
+    for _ in range(epochs):
+        for labels, rows in batches:
+            B = len(labels)
+            uniq = sorted({int(k) for r in rows for k in r})
+            widx = {k: i for i, k in enumerate(uniq)}
+            w = table.pull(uniq)[:, 0]
+            g = np.zeros(len(uniq))
+            for y, r in zip(labels, rows):
+                wx = sum(w[widx[int(k)]] for k in r)
+                loss = _sigmoid_ref(wx) - y
+                for k in r:  # per occurrence (lr_worker.cc:106-115)
+                    g[widx[int(k)]] += loss
+            table.push(uniq, g / B)
+    return table
+
+
+def sim_predict_lr(table: FTRLTable, rows) -> np.ndarray:
+    out = []
+    for r in rows:
+        uniq = sorted({int(k) for k in r})
+        w = {k: table.pull([k])[0, 0] if k in table.store else 0.0 for k in uniq}
+        # predict-time pull also lazily creates entries in the reference;
+        # value is 0 for fresh w entries either way
+        out.append(_sigmoid_ref(sum(w[int(k)] for k in r)))
+    return np.asarray(out)
+
+
+def sim_train_fm(batches, epochs: int, k: int = 10, seed: int = 0):
+    """Reference-coupled FM (fm_worker.cc): scalar accumulator across
+    (occurrence, latent) with hand-written gradients."""
+    rng = np.random.default_rng(seed)
+    wt = FTRLTable()
+    vt = FTRLTable(dim=k, rng=rng)
+    for _ in range(epochs):
+        for labels, rows in batches:
+            B = len(labels)
+            uniq = sorted({int(key) for r in rows for key in r})
+            idx = {key: i for i, key in enumerate(uniq)}
+            w = wt.pull(uniq)[:, 0]
+            v = vt.pull(uniq)  # [U, k]
+            gw = np.zeros(len(uniq))
+            gv = np.zeros((len(uniq), k))
+            for y, r in zip(labels, rows):
+                ids = [idx[int(key)] for key in r]
+                wx = sum(w[i] for i in ids)
+                vs = sum(v[i, kk] for i in ids for kk in range(k))  # coupled scalar
+                vq = sum(v[i, kk] ** 2 for i in ids for kk in range(k))
+                loss = _sigmoid_ref(wx + vs * vs - vq) - y
+                for i in ids:
+                    # w-grad accumulated once per latent dim (x k): the
+                    # reference accident (fm_worker.cc:134-148)
+                    gw[i] += loss * k
+                    for kk in range(k):
+                        gv[i, kk] += loss * (vs - v[i, kk])
+            wt.push(uniq, gw / B)
+            vt.push(uniq, gv / B)
+    return wt, vt
+
+
+def sim_predict_fm(wt: FTRLTable, vt: FTRLTable, rows, k: int = 10) -> np.ndarray:
+    out = []
+    for r in rows:
+        keys = [int(key) for key in r]
+        w = wt.pull(keys)[:, 0]
+        v = vt.pull(keys)
+        wx = float(w.sum())
+        vs = float(v.sum())
+        vq = float((v * v).sum())
+        out.append(_sigmoid_ref(wx + vs * vs - vq))
+    return np.asarray(out)

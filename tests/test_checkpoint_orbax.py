@@ -25,7 +25,7 @@ def test_orbax_roundtrip_sharded(tmp_path):
     import jax.numpy as jnp
 
     state = state._replace(
-        tables={**state.tables, "w": state.tables["w"] + 0.5},
+        tables={**state.tables, "wv": state.tables["wv"] + 0.5},
         step=jnp.asarray(7, jnp.int32),
     )
     save_orbax(str(tmp_path), state)
@@ -34,13 +34,12 @@ def test_orbax_roundtrip_sharded(tmp_path):
     like = shard_state(init_state(model, opt, cfg), mesh)
     restored = restore_orbax(str(tmp_path), like)
     assert int(restored.step) == 7
-    np.testing.assert_allclose(np.asarray(restored.tables["w"]), np.asarray(state.tables["w"]))
-    np.testing.assert_allclose(np.asarray(restored.tables["v"]), np.asarray(state.tables["v"]))
+    np.testing.assert_allclose(np.asarray(restored.tables["wv"]), np.asarray(state.tables["wv"]))
     np.testing.assert_allclose(
-        np.asarray(restored.opt_state["v"]["n"]), np.asarray(state.opt_state["v"]["n"])
+        np.asarray(restored.opt_state["wv"]["n"]), np.asarray(state.opt_state["wv"]["n"])
     )
     # restored arrays carry the mesh sharding (shards load in place)
-    assert len(restored.tables["w"].addressable_shards) == 8
+    assert len(restored.tables["wv"].addressable_shards) == 8
 
 
 def test_trainer_orbax_resume(tmp_path, monkeypatch):

@@ -107,16 +107,61 @@ def test_mvm_absent_field_is_identity():
 
 
 def test_init_tables_shapes_and_init():
-    cfg = small_cfg()
+    cfg = small_cfg(**{"model.fm_fused": False})
     key = jax.random.PRNGKey(0)
     t_fm = init_tables(get_model("fm"), cfg, key)
     assert t_fm["w"].shape == (1 << LOG2,)
     assert t_fm["v"].shape == (1 << LOG2, 3)
     assert float(jnp.abs(t_fm["w"]).max()) == 0.0  # w starts at 0 (ftrl.h:27-36)
     assert 0 < float(jnp.abs(t_fm["v"]).mean()) < 0.1  # ~N(0,1)*1e-2 (ftrl.h:117)
-    cfg_sgd = small_cfg(**{"optim.name": "sgd"})
+    cfg_sgd = small_cfg(**{"optim.name": "sgd", "model.fm_fused": False})
     t_sgd = init_tables(get_model("fm"), cfg_sgd, key)
     np.testing.assert_allclose(np.asarray(t_sgd["v"]), 1e-3)  # sgd.h:69
+
+
+def test_init_tables_fused_fm():
+    cfg = small_cfg()  # fm_fused defaults True
+    t = init_tables(get_model("fm"), cfg, jax.random.PRNGKey(0))
+    assert set(t) == {"wv"}
+    assert t["wv"].shape == (1 << LOG2, 4)  # 1 + v_dim
+    assert float(jnp.abs(t["wv"][:, 0]).max()) == 0.0  # w column zero-init
+    assert 0 < float(jnp.abs(t["wv"][:, 1:]).mean()) < 0.1  # v columns random
+
+
+def test_fm_fused_matches_two_table_layout():
+    # the fused [S, 1+k] table must compute identical forwards and, after a
+    # train step, identical updated parameters as the two-table layout
+    from xflow_tpu.optim import get_optimizer
+    from xflow_tpu.train.state import TrainState
+    from xflow_tpu.train.step import make_train_step
+
+    cfg_f = small_cfg()
+    cfg_u = small_cfg(**{"model.fm_fused": False})
+    model = get_model("fm")
+    rng = np.random.default_rng(4)
+    w = rng.normal(size=(1 << LOG2,)).astype(np.float32) * 0.1
+    v = rng.normal(size=(1 << LOG2, 3)).astype(np.float32) * 0.1
+    wv = np.concatenate([w[:, None], v], axis=1)
+    batch = make_batch_arrays(ROWS_SLOTS, ROWS_FIELDS, LABELS)
+
+    out_u = model.forward({"w": jnp.asarray(w), "v": jnp.asarray(v)}, batch, cfg_u)
+    out_f = model.forward({"wv": jnp.asarray(wv)}, batch, cfg_f)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_u), rtol=1e-6)
+
+    opt = get_optimizer("ftrl")
+    t_u = {"w": jnp.asarray(w), "v": jnp.asarray(v)}
+    t_f = {"wv": jnp.asarray(wv)}
+    s_u = TrainState(t_u, opt.init_state(t_u), jnp.zeros((), jnp.int32))
+    s_f = TrainState(t_f, opt.init_state(t_f), jnp.zeros((), jnp.int32))
+    s_u, m_u = make_train_step(model, opt, cfg_u)(s_u, batch)
+    s_f, m_f = make_train_step(model, opt, cfg_f)(s_f, batch)
+    assert float(m_u["loss"]) == pytest.approx(float(m_f["loss"]), rel=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(s_f.tables["wv"][:, 0]), np.asarray(s_u.tables["w"]), rtol=1e-5, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_f.tables["wv"][:, 1:]), np.asarray(s_u.tables["v"]), rtol=1e-5, atol=1e-7
+    )
 
 
 def test_padded_row_gives_zero_logit_lr():

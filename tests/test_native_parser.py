@@ -159,6 +159,45 @@ def test_junk_fgid_parity_with_python(tmp_path):
     assert py[0].fields[6, 0] == 16 and py[0].fields[6, 1] == 1
 
 
+def test_whitespace_and_label_sep_parity(tmp_path):
+    # round-2 review findings: label-only lines with trailing whitespace
+    # must NOT be rows in either parser; the label separator is the first
+    # TAB if any, else the first space; inf/nan-with-junk labels parse via
+    # strtod-prefix semantics in both
+    from xflow_tpu.data.libffm import count_rows
+    from xflow_tpu.data.pipeline import count_batches
+
+    native = _native()
+    p = tmp_path / "ws-00000"
+    p.write_text(
+        "1 \n"                 # label + trailing space: NOT a row
+        "  1\t0:5:1\n"         # leading whitespace stripped
+        "a x:y\t0:6:1\n"       # space before tab: label token is 'a x:y'
+        "infx\t0:7:1\n"        # strtod inf-prefix -> label 1
+        "nanjunk\t0:8:1\n"     # strtod nan-prefix -> nan > 1e-7 false -> 0
+        "1\t \n"               # label + whitespace features -> stripped: row? no sep after strip -> not a row
+        "0 0:9:1 \n"           # trailing space after features
+    )
+    cfg = DataConfig(log2_slots=12, max_nnz=4)
+    py = _batches_python(str(p), cfg, 16)
+    nat = _batches_native(str(p), cfg, 16)
+    assert len(py) == len(nat) == 1
+    for a, b in zip(py, nat):
+        np.testing.assert_array_equal(a.slots, b.slots)
+        np.testing.assert_array_equal(a.fields, b.fields)
+        np.testing.assert_array_equal(a.mask, b.mask)
+        np.testing.assert_array_equal(a.labels, b.labels)
+        np.testing.assert_array_equal(a.row_mask, b.row_mask)
+    assert py[0].num_rows == 5
+    assert py[0].labels[0] == 1.0  # leading-whitespace row parsed
+    assert py[0].labels[1] == 0.0  # 'a x:y' -> strtod 0
+    assert py[0].mask[1].sum() == 1  # only 0:6:1, no phantom token from 'x:y'
+    assert py[0].labels[2] == 1.0  # infx -> inf > 1e-7
+    assert py[0].labels[3] == 0.0  # nanjunk -> nan; nan > 1e-7 is False
+    assert count_rows(str(p)) == native.native_count_rows(str(p), 1 << 20) == 5
+    assert count_batches(str(p), cfg, 16) == 1
+
+
 def test_count_rows_parity(tmp_path):
     from xflow_tpu.data.libffm import count_rows
     from xflow_tpu.data.pipeline import count_batches

@@ -1,0 +1,112 @@
+"""Async-PS parity gate (BASELINE.md config 1).
+
+The round-1 golden test only checked learnability (train-set AUC). This
+gates the actual promise: the framework's synchronous SPMD training
+reaches the same test AUC (within epsilon) as a faithful NumPy
+re-creation of the reference's Pull/compute/Push loop with server-side
+FTRL (tests/ps_simulator.py) — the async->sync semantic shift
+(SURVEY.md SS7 hard part c) costs no model quality.
+
+Runs on the reference's bundled fixture when mounted, else on the
+synthetic generator with the same shape.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.ps_simulator import (
+    sim_predict_fm,
+    sim_predict_lr,
+    sim_train_fm,
+    sim_train_lr,
+)
+from xflow_tpu.config import Config, override
+from xflow_tpu.data.libffm import read_examples
+from xflow_tpu.data.synth import generate_shards
+from xflow_tpu.metrics import auc_logloss
+from xflow_tpu.train.trainer import Trainer
+
+LOG2 = 18
+EPOCHS = 40
+B = 100
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    ref = "/root/reference/data"
+    if os.path.isdir(ref):
+        return os.path.join(ref, "small_train"), os.path.join(ref, "small_test")
+    d = tmp_path_factory.mktemp("psdata")
+    generate_shards(str(d / "small_train"), 1, 100, num_fields=18, ids_per_field=500, seed=1)
+    generate_shards(
+        str(d / "small_test"), 1, 100, num_fields=18, ids_per_field=500, seed=2, truth_seed=1
+    )
+    return str(d / "small_train"), str(d / "small_test")
+
+
+def _batches(path):
+    ex = read_examples(path + "-00000", LOG2)
+    labels = np.asarray([e[0] for e in ex])
+    rows = [e[2] for e in ex]
+    return [
+        (labels[i : i + B], rows[i : i + B]) for i in range(0, len(labels), B)
+    ], labels, rows
+
+
+def _framework_auc(train_prefix, test_prefix, model, extra=None):
+    cfg = override(
+        Config(),
+        **{
+            "data.train_path": train_prefix,
+            "data.test_path": test_prefix,
+            "data.log2_slots": LOG2,
+            "data.batch_size": B,
+            "data.max_nnz": 40,
+            "model.name": model,
+            "model.num_fields": 18,
+            "train.epochs": EPOCHS,
+            "train.pred_dump": False,
+            **(extra or {}),
+        },
+    )
+    t = Trainer(cfg)
+    t.fit()
+    auc, _ = t.evaluate()
+    return auc
+
+
+def test_lr_ftrl_auc_matches_ps_simulator(data):
+    train, test = data
+    batches, _, _ = _batches(train)
+    table = sim_train_lr(batches, EPOCHS)
+    _, test_labels, test_rows = _batches(test)
+    p = sim_predict_lr(table, test_rows)
+    auc_sim, _ = auc_logloss(p, test_labels)
+
+    auc_fw = _framework_auc(train, test, "lr")
+    # the reference's 100-row toy fixture tops out near 0.56 test AUC;
+    # the gate is the sim-vs-framework GAP (measured 0.0000 on the
+    # fixture: LR residual gradients are exact in both)
+    assert auc_sim > 0.52, auc_sim
+    assert abs(auc_fw - auc_sim) < 0.02, (auc_fw, auc_sim)
+
+
+def test_fm_ftrl_auc_matches_ps_simulator(data):
+    train, test = data
+    batches, _, _ = _batches(train)
+    wt, vt = sim_train_fm(batches, EPOCHS, k=10, seed=0)
+    _, test_labels, test_rows = _batches(test)
+    p = sim_predict_fm(wt, vt, test_rows, k=10)
+    auc_sim, _ = auc_logloss(p, test_labels)
+
+    # reference-coupled FM form for apples-to-apples (model.fm_standard=False)
+    auc_fw = _framework_auc(
+        train, test, "fm", {"model.fm_standard": False}
+    )
+    assert auc_sim > 0.52, auc_sim
+    # measured gap 0.014 on the fixture: the simulator uses the
+    # reference's hand-written approximate FM gradients, the framework
+    # exact jax.grad ones — AUC-level equivalence, not trajectory-level
+    assert abs(auc_fw - auc_sim) < 0.05, (auc_fw, auc_sim)

@@ -1,0 +1,94 @@
+"""Decompose the FM/LR train step cost on the real chip.
+
+Uses the bench.py harness (lax.scan over K pre-staged distinct batches,
+host-read sync) with progressively larger slices of the step:
+  fwd      — forward + loss only
+  grad     — + backward (gradients materialized into the carry)
+  step     — + optimizer update (the full train step)
+The deltas attribute the step time to forward gather, backward scatter,
+and dense optimizer update respectively.
+"""
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from xflow_tpu.config import Config, override
+    from xflow_tpu.models import get_model
+    from xflow_tpu.optim import get_optimizer
+    from xflow_tpu.train.state import init_state
+    from xflow_tpu.train.step import loss_fn, make_train_step
+
+    K, B, F, LOG2 = 8, 65536, 32, 22
+    for model_name in ("lr", "fm"):
+        cfg = override(
+            Config(),
+            **{
+                "model.name": model_name,
+                "data.log2_slots": LOG2,
+                "data.max_nnz": F,
+                "data.batch_size": B,
+            },
+        )
+        model, opt = get_model(model_name), get_optimizer("ftrl")
+        state = init_state(model, opt, cfg)
+        rng = np.random.default_rng(0)
+        batches = {
+            "slots": jnp.asarray(rng.integers(0, cfg.num_slots, (K, B, F)), jnp.int32),
+            "fields": jnp.asarray(rng.integers(0, cfg.model.num_fields, (K, B, F)), jnp.int32),
+            "mask": jnp.asarray((rng.random((K, B, F)) < 0.6).astype(np.float32)),
+            "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
+            "row_mask": jnp.ones((K, B), jnp.float32),
+        }
+
+        def time_variant(fn, carry):
+            @jax.jit
+            def run(c, bs):
+                return jax.lax.scan(fn, c, bs)
+
+            c, out = run(carry, batches)
+            _ = float(jax.tree.leaves(out)[0].ravel()[-1])
+            best = float("inf")
+            for _ in range(4):
+                t0 = time.perf_counter()
+                c, out = run(carry, batches)
+                _ = float(jax.tree.leaves(out)[0].ravel()[-1])
+                best = min(best, (time.perf_counter() - t0) / K)
+            return best
+
+        # fwd: tables fixed in carry, loss out
+        def fwd(tables, batch):
+            return tables, loss_fn(tables, batch, model, cfg)
+
+        t_fwd = time_variant(fwd, state.tables)
+
+        # grad: tables updated by -1e-9*grad so the scatter result is live
+        def grad(tables, batch):
+            loss, g = jax.value_and_grad(loss_fn)(tables, batch, model, cfg)
+            new = jax.tree.map(lambda t, gg: t - 1e-9 * gg, tables, g)
+            return new, loss
+
+        t_grad = time_variant(grad, state.tables)
+
+        step = make_train_step(model, opt, cfg, jit=False)
+
+        def full(st, batch):
+            st, m = step(st, batch)
+            return st, m["loss"]
+
+        t_full = time_variant(full, state)
+
+        print(
+            f"{model_name}: fwd={t_fwd*1e3:7.1f} ms  +bwd={t_grad*1e3:7.1f} ms "
+            f"(bwd ~{(t_grad-t_fwd)*1e3:6.1f})  full={t_full*1e3:7.1f} ms "
+            f"(opt ~{(t_full-t_grad)*1e3:6.1f})"
+        )
+
+
+if __name__ == "__main__":
+    main()

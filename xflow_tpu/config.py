@@ -76,6 +76,10 @@ class ModelConfig:
     num_fields: int = 18
     fm_standard: bool = True
     fm_half: bool = True
+    # fused [S, 1+k] w+v table (one gather+scatter pass instead of two;
+    # same math — docs/PERF.md lever 1). False = reference's two-table
+    # layout (`fm_worker.cc:227-242`)
+    fm_fused: bool = True
 
 
 @dataclass(frozen=True)
@@ -100,6 +104,11 @@ class DataConfig:
     block_bytes: int = 2 << 20
     drop_remainder: bool = False  # reference drops remainder rows (lr_worker.cc:190); we pad instead
     use_native_parser: bool = True  # C++ parser if built; falls back to Python
+    # parser worker threads (reference: hardware_concurrency() pool,
+    # thread_pool.h:70-86). 0 = auto (one per core, capped 16); 1 = the
+    # sequential parser. Output is byte-identical either way (blocks are
+    # reassembled in file order).
+    parser_threads: int = 0
 
 
 @dataclass(frozen=True)

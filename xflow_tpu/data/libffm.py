@@ -33,6 +33,11 @@ from xflow_tpu.hashing import fnv1a64, slot_of
 
 _NUM_PREFIX = re.compile(r"^[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
 _HEX_PREFIX = re.compile(r"^[+-]?0[xX][0-9a-fA-F]+(?:\.[0-9a-fA-F]*)?(?:[pP][+-]?\d+)?")
+_INFNAN_PREFIX = re.compile(r"^[+-]?(?:infinity|inf|nan(?:\([a-zA-Z0-9_]*\))?)", re.IGNORECASE)
+# ASCII whitespace only: C code (and strtod) never treats unicode
+# whitespace specially, so the Python path must not either
+_ASCII_WS = " \t\r\n\v\f"
+_TOKEN_SEP = re.compile(r"[ \t\r\v\f]+")
 
 
 def _strtod(tok: str) -> float:
@@ -45,7 +50,7 @@ def _strtod(tok: str) -> float:
     strtod corners Python's float() handles differently: hex floats
     (C99, float() rejects) and underscore digit groups (float() accepts,
     strtod stops at the underscore)."""
-    tok = tok.strip()
+    tok = tok.strip(_ASCII_WS)
     if "_" not in tok:
         try:
             return float(tok)  # fast path; also covers inf/nan like strtod
@@ -54,6 +59,10 @@ def _strtod(tok: str) -> float:
     m = _HEX_PREFIX.match(tok)
     if m:
         return float.fromhex(m.group(0))
+    m = _INFNAN_PREFIX.match(tok)
+    if m:
+        # strtod parses 'inf'/'infinity'/'nan(...)' prefixes with junk after
+        return float(re.sub(r"\(.*\)", "", m.group(0)))
     m = _NUM_PREFIX.match(tok)
     return float(m.group(0)) if m else 0.0
 
@@ -79,7 +88,7 @@ def parse_line(
     line: str, log2_slots: int, salt: int = 0
 ) -> Optional[tuple[float, np.ndarray, np.ndarray]]:
     """Parse one libffm line → (label, fields[int32], slots[int32])."""
-    line = line.strip()
+    line = line.strip(_ASCII_WS)
     if not line:
         return None
     parts = line.split("\t", 1)
@@ -91,7 +100,7 @@ def parse_line(
     label = 1.0 if _strtod(parts[0]) > 1e-7 else 0.0
     fields = []
     slots = []
-    for tok in parts[1].split():
+    for tok in _TOKEN_SEP.split(parts[1]):
         pieces = tok.split(":")
         if len(pieces) < 2:
             continue
@@ -128,7 +137,7 @@ def count_rows(path: str) -> int:
     n = 0
     with open(path, "r") as f:
         for line in f:
-            s = line.strip()
+            s = line.strip(_ASCII_WS)
             if s and ("\t" in s or " " in s):
                 n += 1
     return n

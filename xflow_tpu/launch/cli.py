@@ -107,7 +107,7 @@ def cmd_gen_data(args) -> int:
     paths = generate_shards(
         args.out_prefix, args.shards, args.rows,
         num_fields=args.fields, ids_per_field=args.ids_per_field, seed=args.seed,
-        truth_seed=args.truth_seed,
+        truth_seed=args.truth_seed, zipf_alpha=args.zipf_alpha,
     )
     print("\n".join(paths))
     return 0
@@ -125,7 +125,18 @@ def cmd_export(args) -> int:
         print(f"no committed checkpoint in {args.checkpoint_dir}", file=sys.stderr)
         return 1
     data = np.load(os.path.join(args.checkpoint_dir, f"step_{step}", "state.npz"))
-    n = export_sparse_array(data[f"tables/{args.table}"], args.out)
+    key = f"tables/{args.table}"
+    if key in data:
+        arr = data[key]
+    elif args.table in ("w", "v") and "tables/wv" in data:
+        # fused FM layout: w is column 0, v the rest (models/fm.py)
+        wv = data["tables/wv"]
+        arr = wv[:, 0] if args.table == "w" else wv[:, 1:]
+    else:
+        have = sorted(k.split("/", 1)[1] for k in data.files if k.startswith("tables/"))
+        print(f"no table {args.table!r} in checkpoint; have {have}", file=sys.stderr)
+        return 1
+    n = export_sparse_array(arr, args.out)
     print(json.dumps({"step": step, "table": args.table, "nonzero": n}))
     return 0
 
@@ -190,6 +201,8 @@ def main(argv=None) -> int:
     gd.add_argument("--truth-seed", type=int, default=None,
                     help="seed for the planted ground truth (default: --seed); use the "
                          "same value for train/test splits generated with different --seed")
+    gd.add_argument("--zipf-alpha", type=float, default=0.0,
+                    help="power-law feature skew (0 = uniform; ~1.1 ≈ CTR-like)")
     gd.set_defaults(fn=cmd_gen_data)
 
     ex = sub.add_parser("export", help="export nonzero weights from a checkpoint")

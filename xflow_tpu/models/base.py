@@ -68,9 +68,12 @@ def init_tables(model: Model, cfg: Config, key: jax.Array) -> Dict[str, jax.Arra
         else:
             key, sub = jax.random.split(key)
             if cfg.optim.name == "sgd":
-                tables[tname] = jnp.full(shape, cfg.optim.v_init_sgd, dtype=jnp.float32)
+                t = jnp.full(shape, cfg.optim.v_init_sgd, dtype=jnp.float32)
             else:
-                tables[tname] = (
-                    jax.random.normal(sub, shape, dtype=jnp.float32) * cfg.optim.v_init_scale
-                )
+                t = jax.random.normal(sub, shape, dtype=jnp.float32) * cfg.optim.v_init_scale
+            if tname == "wv":
+                # fused FM layout: column 0 is the linear w (zero-init like
+                # a scalar w-table), columns 1.. are the latent v
+                t = t.at[:, 0].set(0.0)
+            tables[tname] = t
     return tables

@@ -15,6 +15,17 @@ with `cfg.model.fm_half=False` dropping the ½ (the reference also omits
 it) and `cfg.model.fm_standard=False` reproducing the reference's
 coupled form exactly for parity experiments. Gradients are exact
 (`jax.grad`), not the reference's approximation.
+
+Table layout: ONE fused ``wv [S, 1+k]`` table (column 0 = w, columns
+1..k = v) instead of the reference's two server tables
+(`fm_worker.cc:227-242` pulls/pushes w and v separately). The step's
+cost is dominated by latency-bound table row gathers/scatters (
+docs/PERF.md), so fusing halves the number of gather+scatter passes —
+a row of 1+k floats costs about the same as a scalar. FTRL/SGD are
+elementwise, so optimizing the fused table is exactly equivalent to
+optimizing the two tables separately. `cfg.model.fm_fused=False` (or
+passing explicit {"w","v"} tables) keeps the two-table layout for
+parity experiments; both layouts compute the same math.
 """
 
 from __future__ import annotations
@@ -25,15 +36,13 @@ from xflow_tpu.models.base import Model, register_model
 
 
 def _table_specs(cfg):
+    if cfg.model.fm_fused:
+        return {"wv": (1 + cfg.model.v_dim,)}
     return {"w": (), "v": (cfg.model.v_dim,)}
 
 
-def forward(tables, batch, cfg):
-    w, v = tables["w"], tables["v"]
-    mask = batch["mask"]
-    wg = w[batch["slots"]]  # [B, F]
-    wx = (wg * mask).sum(axis=-1)
-    vg = v[batch["slots"]] * mask[..., None]  # [B, F, k]
+def _second_order(vg, cfg):
+    """vg: [B, F, k] masked latent gathers -> [B] second-order term."""
     if cfg.model.fm_standard:
         s = vg.sum(axis=1)  # [B, k]
         q = (vg * vg).sum(axis=1)  # [B, k]
@@ -45,7 +54,22 @@ def forward(tables, batch, cfg):
         s = vg.sum(axis=(1, 2))
         q = (vg * vg).sum(axis=(1, 2))
         second = s * s - q
-    return wx + second
+    return second
+
+
+def forward(tables, batch, cfg):
+    mask = batch["mask"]
+    if "wv" in tables:
+        # fused: ONE row gather for w and v (and one scatter in backward)
+        wvg = tables["wv"][batch["slots"]]  # [B, F, 1+k]
+        wx = (wvg[..., 0] * mask).sum(axis=-1)
+        vg = wvg[..., 1:] * mask[..., None]
+    else:
+        w, v = tables["w"], tables["v"]
+        wg = w[batch["slots"]]  # [B, F]
+        wx = (wg * mask).sum(axis=-1)
+        vg = v[batch["slots"]] * mask[..., None]  # [B, F, k]
+    return wx + _second_order(vg, cfg)
 
 
 MODEL = register_model(Model(name="fm", table_specs=_table_specs, forward=forward))

@@ -26,11 +26,16 @@
 //   xf_parser_truncated(handle) -> truncated-feature count so far
 //   xf_parser_close(handle)
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -63,6 +68,72 @@ inline int32_t fgid_i32(double d) {
   if (d >= 2147483647.0) return 2147483647;
   if (d <= -2147483648.0) return INT32_MIN;
   return static_cast<int32_t>(d);
+}
+
+// Parse one CR-stripped line into padded row buffers (srow/frow/mrow are
+// max_nnz-stride spans, assumed zeroed). Returns true iff the line is a
+// row (non-empty with a label separator). Shared by the single-threaded
+// and multi-threaded parsers so their outputs are byte-identical.
+inline bool parse_row(const char* line, size_t len, long max_nnz,
+                      int log2_slots, uint64_t salt, int32_t* srow,
+                      int32_t* frow, float* mrow, float* label,
+                      long* truncated) {
+  // strip surrounding ASCII whitespace exactly like the Python path's
+  // line.strip(): a label-only line with trailing spaces is NOT a row
+  auto is_ws = [](char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+  };
+  while (len > 0 && is_ws(line[len - 1])) --len;
+  while (len > 0 && is_ws(line[0])) {
+    ++line;
+    --len;
+  }
+  if (len == 0) return false;
+  const char* cur = line;
+  const char* lend = line + len;
+  // label/features separator: the FIRST TAB if the line has one, else the
+  // first space — mirroring parse_line's split("\t", 1) -> split(" ", 1)
+  const char* tab =
+      static_cast<const char*>(memchr(cur, '\t', static_cast<size_t>(len)));
+  if (tab == nullptr)
+    tab = static_cast<const char*>(memchr(cur, ' ', static_cast<size_t>(len)));
+  if (tab == nullptr) return false;  // malformed: no features
+  *label = (strtod(cur, nullptr) > 1e-7) ? 1.0f : 0.0f;
+  cur = tab + 1;
+  long nnz = 0;
+  // tokens split on any whitespace, matching the Python path's .split()
+  auto is_sep = is_ws;
+  while (cur < lend) {
+    while (cur < lend && is_sep(*cur)) ++cur;
+    if (cur >= lend) break;
+    const char* tok_end = cur;
+    while (tok_end < lend && !is_sep(*tok_end)) ++tok_end;
+    // token = fgid:fid[:value...]; value never parsed (reference
+    // behavior: load_data_from_disk.cc:150-153 breaks after fid)
+    const char* c1 = static_cast<const char*>(
+        memchr(cur, ':', static_cast<size_t>(tok_end - cur)));
+    if (c1 != nullptr) {
+      const char* c2 = static_cast<const char*>(
+          memchr(c1 + 1, ':', static_cast<size_t>(tok_end - c1 - 1)));
+      const char* fid_end = (c2 != nullptr) ? c2 : tok_end;
+      if (nnz < max_nnz) {
+        frow[nnz] = fgid_i32(strtod(cur, nullptr));
+        uint64_t key =
+            fnv1a64(c1 + 1, static_cast<size_t>(fid_end - c1 - 1), salt);
+        srow[nnz] = static_cast<int32_t>(mix64(key) &
+                                         ((1ULL << log2_slots) - 1ULL));
+        mrow[nnz] = 1.0f;
+        ++nnz;
+      } else {
+        ++*truncated;
+      }
+    }
+    cur = tok_end;
+  }
+  // rows with zero valid features are kept (mask all-zero), matching the
+  // Python path: a labeled line is an example even if its features are
+  // unparseable
+  return true;
 }
 
 struct Parser {
@@ -163,54 +234,12 @@ long xf_parser_next_batch(void* handle, long batch_size, long max_nnz,
       if (p->error) return -1;
       break;
     }
-    while (len > 0 && (line[len - 1] == '\r')) --len;  // CRLF input
-    if (len == 0) continue;
-    const char* cur = line;
-    const char* lend = line + len;
-    // label token ends at tab (or space)
-    const char* tab = cur;
-    while (tab < lend && *tab != '\t' && *tab != ' ') ++tab;
-    if (tab == lend) continue;  // malformed: no features
-    labels[row] = (strtod(cur, nullptr) > 1e-7) ? 1.0f : 0.0f;
-    row_mask[row] = 1.0f;
-    cur = tab + 1;
-    long nnz = 0;
-    int32_t* srow = slots + row * max_nnz;
-    int32_t* frow = fields + row * max_nnz;
-    float* mrow = mask + row * max_nnz;
-    // tokens split on any whitespace, matching the Python path's .split()
-    auto is_sep = [](char c) { return c == ' ' || c == '\t' || c == '\r'; };
-    while (cur < lend) {
-      while (cur < lend && is_sep(*cur)) ++cur;
-      if (cur >= lend) break;
-      const char* tok_end = cur;
-      while (tok_end < lend && !is_sep(*tok_end)) ++tok_end;
-      // token = fgid:fid[:value...]; value never parsed (reference
-      // behavior: load_data_from_disk.cc:150-153 breaks after fid)
-      const char* c1 = static_cast<const char*>(
-          memchr(cur, ':', static_cast<size_t>(tok_end - cur)));
-      if (c1 != nullptr) {
-        const char* c2 = static_cast<const char*>(
-            memchr(c1 + 1, ':', static_cast<size_t>(tok_end - c1 - 1)));
-        const char* fid_end = (c2 != nullptr) ? c2 : tok_end;
-        if (nnz < max_nnz) {
-          frow[nnz] = fgid_i32(strtod(cur, nullptr));
-          uint64_t key =
-              fnv1a64(c1 + 1, static_cast<size_t>(fid_end - c1 - 1), salt);
-          srow[nnz] = static_cast<int32_t>(mix64(key) &
-                                           ((1ULL << log2_slots) - 1ULL));
-          mrow[nnz] = 1.0f;
-          ++nnz;
-        } else {
-          ++p->truncated;
-        }
-      }
-      cur = tok_end;
+    if (parse_row(line, len, max_nnz, log2_slots, salt, slots + row * max_nnz,
+                  fields + row * max_nnz, mask + row * max_nnz, labels + row,
+                  &p->truncated)) {
+      row_mask[row] = 1.0f;
+      ++row;
     }
-    // rows with zero valid features are kept (mask all-zero), matching the
-    // Python path: a labeled line is an example even if its features are
-    // unparseable
-    ++row;
   }
   return row;
 }
@@ -220,6 +249,258 @@ void xf_parser_close(void* handle) {
   if (p->fp != nullptr) fclose(p->fp);
   delete p;
 }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Multi-threaded parser pool.
+//
+// The reference fans parsing + compute over hardware_concurrency() worker
+// threads (/root/reference/src/base/thread_pool.h:70-86, lr_worker.cc:190-199)
+// with no ordering guarantees (hogwild). Here the host data plane is the
+// bottleneck feeder for a synchronous SPMD device step, so the design is:
+// N workers each parse disjoint ~block_bytes file blocks (newline-aligned)
+// into padded row buffers, and a sequencer drains blocks IN FILE ORDER —
+// output is byte-identical to the single-threaded parser, keeping training
+// deterministic, while hashing/strtod (the actual cost) runs in parallel.
+// A bounded window (2x threads) of in-flight blocks caps memory.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct ParsedBlock {
+  long rows = 0;
+  std::vector<float> labels;
+  std::vector<int32_t> slots, fields;
+  std::vector<float> mask;
+  long truncated = 0;
+  bool error = false;
+};
+
+struct MtParser {
+  std::string path;
+  long block_bytes = 0, max_nnz = 0;
+  int log2_slots = 0;
+  uint64_t salt = 0;
+  long n_blocks = 0;
+  long window = 0;  // max blocks a worker may run ahead of the consumer
+
+  std::atomic<long> next_block{0};
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::map<long, ParsedBlock> ready;
+  long consume_idx = 0;  // next block index the consumer needs
+  bool shutdown = false;
+  std::vector<std::thread> threads;
+
+  // consumer-side cursor
+  ParsedBlock cur;
+  long cur_row = 0;
+  bool failed = false;
+  long truncated_total = 0;
+
+  ~MtParser() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      shutdown = true;
+    }
+    cv_space.notify_all();
+    for (auto& t : threads) t.join();
+  }
+
+  ParsedBlock parse_block(long b) {
+    ParsedBlock out;
+    FILE* fp = fopen(path.c_str(), "rb");
+    if (fp == nullptr) {
+      out.error = true;
+      return out;
+    }
+    // Read from one byte before the block so we can tell whether the
+    // block boundary falls exactly on a line start (previous byte '\n').
+    long base = b * block_bytes - (b > 0 ? 1 : 0);
+    if (fseek(fp, base, SEEK_SET) != 0) {
+      out.error = true;
+      fclose(fp);
+      return out;
+    }
+    std::vector<char> data;
+    size_t want = static_cast<size_t>(block_bytes + (b > 0 ? 1 : 0));
+    data.resize(want + 4096);
+    size_t size = fread(data.data(), 1, data.size(), fp);
+    bool eof = size < data.size();
+    if (eof && ferror(fp)) {
+      out.error = true;
+      fclose(fp);
+      return out;
+    }
+    // limit: lines whose first byte lies within this block
+    size_t limit = want < size ? want : size;
+    size_t pos = 0;
+    if (b > 0) {
+      if (size == 0) {
+        fclose(fp);
+        return out;  // past EOF
+      }
+      if (data[0] != '\n') {
+        // mid-line start: the line belongs to the previous block; skip it
+        const char* nl =
+            static_cast<const char*>(memchr(data.data(), '\n', size));
+        if (nl == nullptr) {
+          fclose(fp);
+          return out;  // a single line spans the whole block
+        }
+        pos = static_cast<size_t>(nl - data.data()) + 1;
+      } else {
+        pos = 1;
+      }
+    }
+    while (pos < limit) {
+      // ensure the line starting at pos is fully buffered
+      const char* nl = static_cast<const char*>(
+          memchr(data.data() + pos, '\n', size - pos));
+      while (nl == nullptr && !eof) {
+        size_t old = size;
+        data.resize(data.size() + (64 << 10));
+        size_t got = fread(data.data() + old, 1, data.size() - old, fp);
+        size += got;
+        eof = size < data.size();
+        if (eof && ferror(fp)) {
+          out.error = true;
+          fclose(fp);
+          return out;
+        }
+        nl = static_cast<const char*>(
+            memchr(data.data() + old, '\n', size - old));
+      }
+      size_t line_end = nl ? static_cast<size_t>(nl - data.data()) : size;
+      long r = out.rows;
+      out.labels.resize(r + 1, 0.0f);
+      out.slots.resize((r + 1) * max_nnz, 0);
+      out.fields.resize((r + 1) * max_nnz, 0);
+      out.mask.resize((r + 1) * max_nnz, 0.0f);
+      if (parse_row(data.data() + pos, line_end - pos, max_nnz, log2_slots,
+                    salt, out.slots.data() + r * max_nnz,
+                    out.fields.data() + r * max_nnz,
+                    out.mask.data() + r * max_nnz, out.labels.data() + r,
+                    &out.truncated)) {
+        out.rows = r + 1;
+      }
+      if (nl == nullptr) break;  // final unterminated line
+      pos = line_end + 1;
+    }
+    // shrink over-allocated last row if the final line was not a row
+    out.labels.resize(out.rows);
+    out.slots.resize(out.rows * max_nnz);
+    out.fields.resize(out.rows * max_nnz);
+    out.mask.resize(out.rows * max_nnz);
+    fclose(fp);
+    return out;
+  }
+
+  void worker() {
+    for (;;) {
+      long b = next_block.fetch_add(1);
+      if (b >= n_blocks) return;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_space.wait(lk, [&] { return shutdown || b < consume_idx + window; });
+        if (shutdown) return;
+      }
+      ParsedBlock blk = parse_block(b);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready.emplace(b, std::move(blk));
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* xf_mt_open(const char* path, long block_bytes, int threads, long max_nnz,
+                 int log2_slots, uint64_t salt) {
+  FILE* fp = fopen(path, "rb");
+  if (fp == nullptr) return nullptr;
+  fseek(fp, 0, SEEK_END);
+  long fsize = ftell(fp);
+  fclose(fp);
+  if (fsize < 0) return nullptr;
+  MtParser* p = new MtParser();
+  p->path = path;
+  p->block_bytes = block_bytes > 4096 ? block_bytes : 4096;
+  p->max_nnz = max_nnz;
+  p->log2_slots = log2_slots;
+  p->salt = salt;
+  p->n_blocks = (fsize + p->block_bytes - 1) / p->block_bytes;
+  if (threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    threads = hw > 0 ? static_cast<int>(hw) : 4;
+  }
+  if (threads > 16) threads = 16;
+  if (static_cast<long>(threads) > p->n_blocks && p->n_blocks > 0)
+    threads = static_cast<int>(p->n_blocks);
+  if (threads < 1) threads = 1;
+  p->window = 2L * threads;
+  for (int i = 0; i < threads; ++i)
+    p->threads.emplace_back(&MtParser::worker, p);
+  return p;
+}
+
+long xf_mt_truncated(void* handle) {
+  return static_cast<MtParser*>(handle)->truncated_total;
+}
+
+// Same output contract as xf_parser_next_batch (buffers zero-initialized
+// by the caller); parse parameters were fixed at xf_mt_open.
+long xf_mt_next_batch(void* handle, long batch_size, int32_t* slots,
+                      int32_t* fields, float* mask, float* labels,
+                      float* row_mask) {
+  MtParser* p = static_cast<MtParser*>(handle);
+  if (p->failed) return -1;
+  long row = 0;
+  long nnz = p->max_nnz;
+  while (row < batch_size) {
+    if (p->cur_row >= p->cur.rows) {
+      // current block exhausted: pull the next one, in file order
+      std::unique_lock<std::mutex> lk(p->mu);
+      if (p->consume_idx >= p->n_blocks) break;  // all input consumed
+      long want = p->consume_idx;
+      p->cv_ready.wait(lk, [&] { return p->ready.count(want) != 0; });
+      p->cur = std::move(p->ready[want]);
+      p->ready.erase(want);
+      p->consume_idx = want + 1;
+      p->truncated_total += p->cur.truncated;
+      p->cur_row = 0;
+      lk.unlock();
+      p->cv_space.notify_all();
+      if (p->cur.error) {
+        p->failed = true;
+        return -1;
+      }
+      continue;
+    }
+    long take = batch_size - row;
+    long avail = p->cur.rows - p->cur_row;
+    if (take > avail) take = avail;
+    memcpy(labels + row, p->cur.labels.data() + p->cur_row,
+           take * sizeof(float));
+    memcpy(slots + row * nnz, p->cur.slots.data() + p->cur_row * nnz,
+           take * nnz * sizeof(int32_t));
+    memcpy(fields + row * nnz, p->cur.fields.data() + p->cur_row * nnz,
+           take * nnz * sizeof(int32_t));
+    memcpy(mask + row * nnz, p->cur.mask.data() + p->cur_row * nnz,
+           take * nnz * sizeof(float));
+    for (long i = 0; i < take; ++i) row_mask[row + i] = 1.0f;
+    row += take;
+    p->cur_row += take;
+  }
+  return row;
+}
+
+void xf_mt_close(void* handle) { delete static_cast<MtParser*>(handle); }
 
 // Count the rows xf_parser_next_batch would produce for this file — the
 // EXACT same line predicate (CR-stripped non-empty line containing a
@@ -232,15 +513,21 @@ long xf_count_rows(const char* path, long block_bytes) {
   Parser* p = static_cast<Parser*>(handle);
   long rows = 0;
   size_t len = 0;
+  auto is_ws = [](char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+  };
   for (;;) {
     const char* line = p->next_line(&len);
     if (line == nullptr) break;
-    while (len > 0 && (line[len - 1] == '\r')) --len;
+    // same strip as parse_row: a row iff the STRIPPED line still contains
+    // a label separator (tab or space)
+    while (len > 0 && is_ws(line[len - 1])) --len;
+    while (len > 0 && is_ws(line[0])) {
+      ++line;
+      --len;
+    }
     if (len == 0) continue;
     if (memchr(line, '\t', len) != nullptr || memchr(line, ' ', len) != nullptr) {
-      // separator must come before the end: matches the batch parser's
-      // "label token ends before lend" check because memchr can only
-      // find it at index < len
       ++rows;
     }
   }

@@ -99,6 +99,14 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
     data = np.load(os.path.join(path, "state.npz"))
 
     def put(name: str, template):
+        if name not in data:
+            raise KeyError(
+                f"checkpoint {path!r} has no array {name!r} (has "
+                f"{sorted(data.files)}). If this is an FM checkpoint written "
+                "with the two-table layout, set model.fm_fused=false to "
+                "restore it (or re-train; the fused [S,1+k] layout is the "
+                "current default)."
+            )
         arr = data[name]
         sharding = getattr(template, "sharding", None)
         return jax.device_put(arr, sharding) if sharding is not None else arr
@@ -155,8 +163,20 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
 
     abstract = jax.tree.map(as_abstract, like._asdict())
-    with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, abstract)
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(path, abstract)
+    except Exception as e:
+        if "wv" in like.tables:
+            # likely a pre-fused FM checkpoint (two-table layout): surface a
+            # migration hint instead of orbax's raw tree-mismatch error
+            raise RuntimeError(
+                f"orbax restore of {path!r} failed ({e}). If this is an FM "
+                "checkpoint written with the two-table layout, set "
+                "model.fm_fused=false to restore it — the fused [S,1+k] "
+                "layout is the current default."
+            ) from e
+        raise
     return TrainState(**restored)
 
 
@@ -179,5 +199,12 @@ def export_sparse_array(w: np.ndarray, out_path: str) -> int:
 
 
 def export_sparse(state: TrainState, out_path: str, table: str = "w") -> int:
-    """Dump nonzero weights of a table as `slot\\tweight` text; returns count."""
+    """Dump nonzero weights of a table as `slot\\tweight` text; returns count.
+
+    Understands the fused FM layout (models/fm.py): requesting "w" or "v"
+    from a state holding only "wv" slices the corresponding columns."""
+    if table not in state.tables and table in ("w", "v") and "wv" in state.tables:
+        wv = _to_host(state.tables["wv"])
+        arr = wv[:, 0] if table == "w" else wv[:, 1:]
+        return export_sparse_array(arr, out_path)
     return export_sparse_array(_to_host(state.tables[table]), out_path)
