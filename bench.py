@@ -37,6 +37,8 @@ def main() -> int:
     ap.add_argument("--repeats", type=int, default=5)
     ap.add_argument("--model", default="all", help="lr|fm|mvm|all (all = one JSON line, LR headline)")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes for CI")
+    ap.add_argument("--no-sorted", action="store_true",
+                    help="disable the sorted-window FM path (ops/sorted_table.py)")
     args = ap.parse_args()
     if args.smoke:
         args.batch, args.log2_slots, args.scan_steps, args.repeats = 2048, 16, 4, 2
@@ -74,13 +76,24 @@ def main() -> int:
         model, opt = get_model(name), get_optimizer("ftrl")
         state = init_state(model, opt, cfg)
         step = make_train_step(model, opt, cfg, jit=False)
+        slots_np = rng.integers(0, cfg.num_slots, (K, B, F)).astype(np.int32)
+        mask_np = (rng.random((K, B, F)) < 0.6).astype(np.float32)
         batches = {
-            "slots": jnp.asarray(rng.integers(0, cfg.num_slots, (K, B, F)), jnp.int32),
+            "slots": jnp.asarray(slots_np),
             "fields": jnp.asarray(rng.integers(0, cfg.model.num_fields, (K, B, F)), jnp.int32),
-            "mask": jnp.asarray((rng.random((K, B, F)) < 0.6).astype(np.float32)),
+            "mask": jnp.asarray(mask_np),
             "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
             "row_mask": jnp.ones((K, B), jnp.float32),
         }
+        if name == "fm" and not args.no_sorted:
+            # sorted-window layout (ops/sorted_table.py): host-side plan
+            from xflow_tpu.ops.sorted_table import plan_sorted_batch
+
+            plans = [plan_sorted_batch(slots_np[i], mask_np[i], cfg.num_slots) for i in range(K)]
+            batches["sorted_slots"] = jnp.asarray(np.stack([p.sorted_slots for p in plans]))
+            batches["sorted_row"] = jnp.asarray(np.stack([p.sorted_row for p in plans]))
+            batches["sorted_mask"] = jnp.asarray(np.stack([p.sorted_mask for p in plans]))
+            batches["win_off"] = jnp.asarray(np.stack([p.win_off for p in plans]))
 
         @jax.jit
         def run_k_steps(state, batches):

@@ -109,6 +109,11 @@ class DataConfig:
     # sequential parser. Output is byte-identical either way (blocks are
     # reassembled in file order).
     parser_threads: int = 0
+    # sorted-window table layout (ops/sorted_table.py): "auto" enables it
+    # for single-device fused-FM training (where the windowed MXU
+    # gather/scatter replaces latency-bound random HBM access); "on"/"off"
+    # force it. Identical math either way (equality-tested).
+    sorted_layout: str = "auto"
 
 
 @dataclass(frozen=True)
@@ -136,6 +141,10 @@ class TrainConfig:
     checkpoint_format: str = "npz"  # "npz" (host-gathered) | "orbax" (sharded OCDBT)
     resume: bool = True
     pred_dump: bool = True  # write pred_<rank>_<block>.txt like lr_worker.cc:74-78
+    # >0: streaming bucketed eval (local histograms + one collective; no
+    # host ever holds the global pctr vector — the Criteo-1TB-scale path).
+    # 0: exact rank-sum AUC with a host sort (reference parity, base.h:84-110)
+    eval_buckets: int = 0
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
     profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
 

@@ -57,7 +57,41 @@ def _second_order(vg, cfg):
     return second
 
 
+def _forward_sorted(tables, batch, cfg):
+    """Sorted-window path (ops/sorted_table.py): occurrences arrive
+    slot-sorted from the host; the table gather/scatter streams W-slot
+    windows with MXU one-hot matmuls (no random HBM access at table
+    scale) and per-row sums cross through small [B, k] segment arrays."""
+    import jax
+
+    from xflow_tpu.ops.sorted_table import table_gather_sorted
+
+    wv = tables["wv"]
+    K = wv.shape[1]
+    occ_t = table_gather_sorted(wv, batch["sorted_slots"], batch["win_off"])  # [K8, Np]
+    m = batch["sorted_mask"]
+    row = batch["sorted_row"]
+    # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
+    occm_t = occ_t[:K] * m[None, :]
+    B = batch["labels"].shape[0]
+    sums_t = jax.vmap(lambda r: jax.ops.segment_sum(r, row, num_segments=B))(
+        jnp.concatenate([occm_t, occm_t[1:] ** 2], axis=0)
+    )  # [2K-1, B]
+    wx = sums_t[0]
+    s, q = sums_t[1:K], sums_t[K:]  # [k, B] each
+    if cfg.model.fm_standard:
+        second = (s * s - q).sum(axis=0)
+        if cfg.model.fm_half:
+            second = 0.5 * second
+    else:
+        s_all, q_all = s.sum(axis=0), q.sum(axis=0)
+        second = s_all * s_all - q_all
+    return wx + second
+
+
 def forward(tables, batch, cfg):
+    if "sorted_slots" in batch and "wv" in tables:
+        return _forward_sorted(tables, batch, cfg)
     mask = batch["mask"]
     if "wv" in tables:
         # fused: ONE row gather for w and v (and one scatter in backward)

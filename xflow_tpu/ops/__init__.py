@@ -1,0 +1,5 @@
+from xflow_tpu.ops.sorted_table import (  # noqa: F401
+    SortedPlan,
+    plan_sorted_batch,
+    table_gather_sorted,
+)
