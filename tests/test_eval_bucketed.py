@@ -44,6 +44,36 @@ def test_bucketed_eval_matches_exact(tmp_path):
     assert abs(ll_b - ll_exact) < 1e-9, (ll_b, ll_exact)
 
 
+def test_bucketed_eval_dumps_local_rows(tmp_path, monkeypatch):
+    # eval_buckets + pred_dump: the bucketed path still writes the
+    # reference-format per-rank pred file (path choice stays config-only
+    # so collectives match across ranks)
+    monkeypatch.chdir(tmp_path)
+    generate_shards(str(tmp_path / "train"), 1, 300, num_fields=6, ids_per_field=50, seed=1)
+    generate_shards(str(tmp_path / "test"), 1, 200, num_fields=6, ids_per_field=50, seed=2,
+                    truth_seed=1)
+    t = Trainer(_cfg(tmp_path, **{"train.eval_buckets": 4096, "train.pred_dump": True,
+                                  "train.epochs": 1}))
+    t.fit()
+    auc, _ = t.evaluate()
+    lines = (tmp_path / "pred_0_0.txt").read_text().splitlines()
+    assert len(lines) == 200
+    pctr, one_minus, label = lines[0].split("\t")
+    assert 0.0 <= float(pctr) <= 1.0
+    assert {one_minus, label} <= {"0", "1"} and int(one_minus) == 1 - int(label)
+
+
+def test_sorted_layout_on_rejects_unsupported(tmp_path):
+    import pytest
+
+    (tmp_path / "train-00000").write_text("1\t0:1:1\n")
+    for bad in ({"model.name": "lr"}, {"model.fm_fused": False}):
+        cfg = _cfg(tmp_path, **{"data.sorted_layout": "on", "data.log2_slots": 12,
+                                "model.name": "fm", **bad})
+        with pytest.raises(ValueError, match="sorted_layout=on requires"):
+            Trainer(cfg)
+
+
 def test_bucketed_eval_single_class_nan(tmp_path):
     # all-positive labels: AUC undefined -> nan, like the exact path
     p = tmp_path / "test-00000"

@@ -40,10 +40,12 @@ def test_plan_invariants():
     n = slots.size
     assert plan.sorted_slots.shape[0] % CHUNK == 0
     assert plan.sorted_slots.shape[0] >= n + CHUNK
-    assert np.all(np.diff(plan.sorted_slots[:n]) >= 0)  # sorted
-    assert np.all(plan.sorted_slots[n:] == S)  # pad = invalid slot
+    assert np.all(np.diff(plan.sorted_slots) >= 0)  # sorted incl. pads
+    assert np.all(plan.sorted_slots[n:] == S - 1)  # pad = last slot, mask 0
+    assert np.all(plan.sorted_mask[n:] == 0.0)
     assert plan.win_off.shape == (S // WINDOW + 1,)
-    assert plan.win_off[0] == 0 and plan.win_off[-1] == n
+    # every position (pads included) is owned by some window
+    assert plan.win_off[0] == 0 and plan.win_off[-1] == plan.sorted_slots.shape[0]
     # every occurrence is within its window's range
     for t in range(S // WINDOW):
         seg = plan.sorted_slots[plan.win_off[t] : plan.win_off[t + 1]]
@@ -66,7 +68,13 @@ def test_gather_sorted_matches_direct():
     np.testing.assert_allclose(
         np.asarray(occ_t[:K, :n]).T, table[plan.sorted_slots[:n]], rtol=1e-6
     )
-    np.testing.assert_array_equal(np.asarray(occ_t[:, n:]), 0.0)  # pad cols
+    # pad cols hold row S-1's values (owned by the last window, never
+    # uninitialized memory); consumers mask them out via sorted_mask
+    np.testing.assert_allclose(
+        np.asarray(occ_t[:K, n:]).T,
+        np.broadcast_to(table[S - 1], (occ_t.shape[1] - n, K)),
+        rtol=1e-6,
+    )
     np.testing.assert_array_equal(np.asarray(occ_t[K:]), 0.0)  # pad rows
 
 

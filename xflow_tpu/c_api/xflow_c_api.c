@@ -22,12 +22,18 @@ static int ensure_interp(void) {
   return 0;
 }
 
+/* Call embed.<fn>(*args); steals the `args` reference on every path
+ * (including lookup failure and args==NULL from a failed Py_BuildValue). */
 static PyObject* call(const char* fn, PyObject* args) {
+  if (args == NULL) return NULL;
   PyObject* f = PyObject_GetAttrString(g_embed, fn);
-  if (f == NULL) return NULL;
+  if (f == NULL) {
+    Py_DECREF(args);
+    return NULL;
+  }
   PyObject* r = PyObject_CallObject(f, args);
   Py_DECREF(f);
-  Py_XDECREF(args);
+  Py_DECREF(args);
   return r;
 }
 
@@ -40,6 +46,10 @@ int XFCreate(void** out_handle, const char* train_prefix, const char* test_prefi
   }
   long h = PyLong_AsLong(r);
   Py_DECREF(r);
+  if (h == -1 && PyErr_Occurred()) {
+    PyErr_Print();
+    return -1;
+  }
   *out_handle = (void*)(intptr_t)h;
   return 0;
 }
@@ -65,6 +75,10 @@ int XFStartTrain(void* handle) {
   }
   long rc = PyLong_AsLong(r);
   Py_DECREF(r);
+  if (rc == -1 && PyErr_Occurred()) {
+    PyErr_Print();
+    return -1;
+  }
   return (int)rc;
 }
 
@@ -77,6 +91,10 @@ double XFGetAUC(void* handle) {
   }
   double auc = PyFloat_AsDouble(r);
   Py_DECREF(r);
+  if (auc == -1.0 && PyErr_Occurred()) {
+    PyErr_Print();
+    return NAN;
+  }
   return auc;
 }
 

@@ -36,6 +36,16 @@ def reference_pctr(logits: jnp.ndarray) -> jnp.ndarray:
 def auc_logloss(pctrs: np.ndarray, labels: np.ndarray, log2: bool = False) -> tuple[float, float]:
     """Rank-sum AUC + mean logloss on host. Returns (auc, logloss).
 
+    Sign convention (reference parity, kept deliberately): the returned
+    "logloss" is the mean log-LIKELIHOOD — a NEGATIVE number — exactly
+    as the reference accumulates `label*log(p)+(1-label)*log(1-p)`
+    without negating (`base.h:94-97`). Conventional logloss is its
+    negation; downstream prints/logs keep the reference's sign so
+    numbers are directly comparable against reference output. We fixed
+    the reference's log₂ accident (natural log here; `log2=True`
+    restores it) but not its sign, which is a convention rather than a
+    bug. Documented in docs/PARITY.md (C8).
+
     AUC is NaN when one class is absent (the reference prints only tp_n
     then, `base.h:102-103`).
     """

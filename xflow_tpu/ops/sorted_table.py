@@ -57,7 +57,11 @@ class SortedPlan(NamedTuple):
 
     Arrays are padded to a CHUNK multiple plus one spare chunk so
     aligned [start, start+CHUNK) reads never leave bounds; pad slots are
-    `num_slots` (outside every window), pad mask/row are 0.
+    `num_slots - 1` (the LAST window) with mask/row 0, so every padded
+    position is owned — and therefore written — by some window: the
+    gather output has no uninitialized columns (a pad column holds row
+    `num_slots-1`'s values; consumers must multiply by `sorted_mask`),
+    and the scatter receives a zero cotangent there (mask zeroes it).
     """
 
     sorted_slots: np.ndarray  # int32 [Np]
@@ -81,11 +85,14 @@ def plan_sorted_batch(slots: np.ndarray, mask: np.ndarray, num_slots: int) -> So
     n = flat_slots.shape[0]
     np_len = padded_len(n)
     order = np.argsort(flat_slots, kind="stable").astype(np.int32)
-    ss = flat_slots[order]
-    win_off = np.searchsorted(ss, np.arange(0, num_slots + 1, WINDOW)).astype(np.int32)
     pad = np_len - n
+    ss = np.concatenate([flat_slots[order], np.full(pad, num_slots - 1, np.int32)])
+    # pads sort at (or past) the real occurrences of slot num_slots-1, so
+    # the full padded array is sorted and the last window's range covers
+    # every padded position — nothing is left unwritten by the kernels
+    win_off = np.searchsorted(ss, np.arange(0, num_slots + 1, WINDOW)).astype(np.int32)
     return SortedPlan(
-        sorted_slots=np.concatenate([ss, np.full(pad, num_slots, np.int32)]),
+        sorted_slots=ss,
         sorted_row=np.concatenate([(order // slots.shape[1]).astype(np.int32),
                                    np.zeros(pad, np.int32)]),
         sorted_mask=np.concatenate([flat_mask[order], np.zeros(pad, np.float32)]),
@@ -257,7 +264,9 @@ def _on_tpu() -> bool:
 def table_gather_sorted(table, sorted_slots, win_off):
     """Per-occurrence table rows, transposed: [K8, Np] for slot-sorted
     occurrences. Differentiable in `table`; the VJP is the windowed
-    scatter-add. Rows K..K8 are zero."""
+    scatter-add. Rows K..K8 are zero. Padded columns (positions past the
+    batch's real occurrences) hold row `S-1`'s values, not zeros —
+    multiply by `sorted_mask` before use."""
     if _on_tpu():
         return _gather_pallas(table, sorted_slots, win_off)
     return _gather_xla(table, sorted_slots, win_off)

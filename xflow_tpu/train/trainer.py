@@ -92,21 +92,26 @@ class Trainer:
         from xflow_tpu.ops.sorted_table import WINDOW
 
         sl = cfg.data.sorted_layout
-        self._sorted = (
-            sl == "on"
-            or (
-                sl == "auto"
-                and cfg.model.name == "fm"
-                and cfg.model.fm_fused
-                and mesh is None
-                and cfg.num_slots % WINDOW == 0
-            )
+        supported = cfg.model.name == "fm" and cfg.model.fm_fused and mesh is None
+        self._sorted = sl == "on" or (
+            sl == "auto" and supported and cfg.num_slots % WINDOW == 0
         )
-        if sl == "on" and cfg.num_slots % WINDOW != 0:
-            raise ValueError(
-                f"sorted_layout=on needs num_slots divisible by {WINDOW}; "
-                f"got 2^{cfg.data.log2_slots}"
-            )
+        if sl == "on":
+            # 'on' forces the layout, so reject configurations where it
+            # cannot work instead of failing deep inside sharding/XLA
+            # (or silently paying the host sort for an unused layout)
+            if not supported:
+                raise ValueError(
+                    "sorted_layout=on requires model.name=fm with "
+                    "model.fm_fused=true on a single device (mesh=None); "
+                    f"got model={cfg.model.name} fm_fused={cfg.model.fm_fused} "
+                    f"mesh={'set' if mesh is not None else 'None'}"
+                )
+            if cfg.num_slots % WINDOW != 0:
+                raise ValueError(
+                    f"sorted_layout=on needs num_slots divisible by {WINDOW}; "
+                    f"got 2^{cfg.data.log2_slots}"
+                )
         # MVM keys its views on the field id: a field >= num_fields would be
         # silently dropped by the one-hot, so reject it loudly
         self._validate_fields = cfg.model.name == "mvm"
@@ -301,14 +306,20 @@ class Trainer:
           collective at the end — no host ever materializes the global
           pctr vector, so Criteo-1TB-scale eval streams. AUC error is
           bounded by bucket width (±~1/buckets).
+
+        The exact-vs-bucketed choice depends only on config (identical on
+        every process), never on rank — a per-rank choice would mismatch
+        the collective sequences across processes and deadlock. With
+        buckets on, each rank dumps its OWN rows to ``pred_<rank>_*.txt``
+        (the reference's per-worker files, `lr_worker.cc:74-78`).
         """
         cfg = self.cfg
         path = test_path or shard_path(cfg.data.test_path, self.rank)
         dump = cfg.train.pred_dump if dump is None else dump
         multiproc = jax.process_count() > 1
+        if cfg.train.eval_buckets:
+            return self._evaluate_bucketed(path, cfg.train.eval_buckets, dump, block)
         dump = dump and (not multiproc or self.rank == 0)
-        if cfg.train.eval_buckets and not dump:
-            return self._evaluate_bucketed(path, cfg.train.eval_buckets)
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
         for batch in self._coordinated_batches(path):
@@ -349,13 +360,20 @@ class Trainer:
         auc, ll = auc_logloss(np.concatenate(pctrs), np.concatenate(labels))
         return auc, ll
 
-    def _evaluate_bucketed(self, path: str, num_buckets: int) -> tuple[float, float]:
-        """Streaming eval: local bucket histograms, one collective at the end."""
+    def _evaluate_bucketed(
+        self, path: str, num_buckets: int, dump: bool = False, block: int = 0
+    ) -> tuple[float, float]:
+        """Streaming eval: local bucket histograms, one collective at the end.
+
+        With `dump`, each rank writes its own local rows (reference
+        per-worker pred files) — no cross-rank gather is needed for it.
+        """
         from xflow_tpu.metrics import BucketAUC
 
         pos = np.zeros(num_buckets, np.float64)
         neg = np.zeros(num_buckets, np.float64)
         ll_sum, n_rows = 0.0, 0.0
+        fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         for batch in self._coordinated_batches(path):
             self._check_batch(batch)
             arrays = self._shard_batch(self._batch_arrays(batch))
@@ -370,13 +388,24 @@ class Trainer:
             pc = np.clip(p, eps, 1.0 - eps)
             ll_sum += float((y * np.log(pc) + (1.0 - y) * np.log(1.0 - pc)).sum())
             n_rows += float(rm.sum())
+            if fout:
+                for pi, yi in zip(p, y):
+                    fout.write(f"{pi:.6f}\t{int(1 - yi)}\t{int(yi)}\n")
+        if fout:
+            fout.close()
         stats = np.concatenate([pos, neg, [ll_sum, n_rows]])
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            stats = np.asarray(
-                multihost_utils.process_allgather(stats.astype(np.float32))
-            ).sum(axis=0)
+            # hi/lo float32 split keeps counts beyond 2^24 exact through
+            # the (float32-only without x64) allgather: x = hi + lo with
+            # hi = f32(x), lo = f32(x - hi); summed back in float64
+            hi = stats.astype(np.float32)
+            lo = (stats - hi.astype(np.float64)).astype(np.float32)
+            gathered = np.asarray(
+                multihost_utils.process_allgather(np.stack([hi, lo]))
+            ).astype(np.float64)
+            stats = gathered.reshape(-1, 2, stats.shape[0]).sum(axis=(0, 1))
         pos, neg = stats[:num_buckets], stats[num_buckets : 2 * num_buckets]
         ll_sum, n_rows = float(stats[-2]), float(stats[-1])
         if n_rows == 0:
