@@ -38,7 +38,9 @@ def main() -> int:
     ap.add_argument("--model", default="all", help="lr|fm|mvm|all (all = one JSON line, LR headline)")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes for CI")
     ap.add_argument("--no-sorted", action="store_true",
-                    help="disable the sorted-window FM path (ops/sorted_table.py)")
+                    help="disable the sorted-window layout (FM and MVM; ops/sorted_table.py)")
+    ap.add_argument("--sub-batches", type=int, default=0,
+                    help="sorted-layout sub-batches per step (0 = auto)")
     args = ap.parse_args()
     if args.smoke:
         args.batch, args.log2_slots, args.scan_steps, args.repeats = 2048, 16, 4, 2
@@ -71,6 +73,7 @@ def main() -> int:
                 "data.log2_slots": args.log2_slots,
                 "data.max_nnz": args.nnz,
                 "data.batch_size": args.batch,
+                "data.sorted_sub_batches": args.sub_batches,
             },
         )
         model, opt = get_model(name), get_optimizer("ftrl")
@@ -78,22 +81,39 @@ def main() -> int:
         step = make_train_step(model, opt, cfg, jit=False)
         slots_np = rng.integers(0, cfg.num_slots, (K, B, F)).astype(np.int32)
         mask_np = (rng.random((K, B, F)) < 0.6).astype(np.float32)
+        fields_host = rng.integers(0, cfg.model.num_fields, (K, B, F)).astype(np.int32)
         batches = {
             "slots": jnp.asarray(slots_np),
-            "fields": jnp.asarray(rng.integers(0, cfg.model.num_fields, (K, B, F)), jnp.int32),
+            "fields": jnp.asarray(fields_host),
             "mask": jnp.asarray(mask_np),
             "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
             "row_mask": jnp.ones((K, B), jnp.float32),
         }
-        if name == "fm" and not args.no_sorted:
-            # sorted-window layout (ops/sorted_table.py): host-side plan
-            from xflow_tpu.ops.sorted_table import plan_sorted_batch
+        if name in ("fm", "mvm") and not args.no_sorted:
+            # sorted-window layout (ops/sorted_table.py): host-side plan,
+            # sub-batched like the trainer would (cache-resident row state)
+            from xflow_tpu.ops.sorted_table import plan_sorted_stacked
+            from xflow_tpu.train.trainer import resolve_sub_batches
 
-            plans = [plan_sorted_batch(slots_np[i], mask_np[i], cfg.num_slots) for i in range(K)]
+            ns = resolve_sub_batches(cfg)
+            fields_np = fields_host if name == "mvm" else None
+            plans = [
+                plan_sorted_stacked(
+                    slots_np[i], mask_np[i], cfg.num_slots,
+                    fields=None if fields_np is None else fields_np[i],
+                    num_sub=ns,
+                )
+                for i in range(K)
+            ]
+            print(f"# {name}: sorted layout, sub_batches={ns}", file=sys.stderr)
             batches["sorted_slots"] = jnp.asarray(np.stack([p.sorted_slots for p in plans]))
             batches["sorted_row"] = jnp.asarray(np.stack([p.sorted_row for p in plans]))
             batches["sorted_mask"] = jnp.asarray(np.stack([p.sorted_mask for p in plans]))
             batches["win_off"] = jnp.asarray(np.stack([p.win_off for p in plans]))
+            if name == "mvm":
+                batches["sorted_fields"] = jnp.asarray(
+                    np.stack([p.sorted_fields for p in plans])
+                )
 
         @jax.jit
         def run_k_steps(state, batches):

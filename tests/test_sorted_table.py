@@ -165,6 +165,159 @@ def test_trainer_sorted_layout_matches_off(tmp_path):
     assert auc_on == pytest.approx(auc_off, abs=1e-6)
 
 
+def test_mvm_sorted_forward_and_step_match_rowmajor():
+    from xflow_tpu.optim import get_optimizer
+    from xflow_tpu.train.state import TrainState
+    from xflow_tpu.train.step import make_train_step
+
+    cfg = override(Config(), **{"data.log2_slots": 12, "model.name": "mvm",
+                                "model.v_dim": 3, "model.num_fields": 4,
+                                "data.max_nnz": 6})
+    assert cfg.num_slots == S
+    model = get_model("mvm")
+    rng = np.random.default_rng(9)
+    B, F = 32, 6
+    slots = rng.integers(0, S, (B, F)).astype(np.int32)
+    fields = rng.integers(0, 4, (B, F)).astype(np.int32)
+    mask = (rng.random((B, F)) < 0.8).astype(np.float32)
+    v = (rng.normal(size=(S, 3)) * 0.1).astype(np.float32)
+    labels = (rng.random(B) < 0.5).astype(np.float32)
+    base = {
+        "slots": jnp.asarray(slots),
+        "fields": jnp.asarray(fields),
+        "mask": jnp.asarray(mask),
+        "labels": jnp.asarray(labels),
+        "row_mask": jnp.ones((B,), jnp.float32),
+    }
+    plan = plan_sorted_batch(slots, mask, S, fields=fields)
+    assert plan.sorted_fields is not None
+    n = slots.size
+    # fields ride the same permutation: multiset of (slot, field, mask)
+    got = sorted(zip(plan.sorted_slots[:n].tolist(), plan.sorted_fields[:n].tolist(),
+                     plan.sorted_mask[:n].tolist()))
+    want = sorted(zip(slots.ravel().tolist(), fields.ravel().tolist(),
+                      mask.ravel().tolist()))
+    assert got == want
+    srt = {
+        **base,
+        "sorted_slots": jnp.asarray(plan.sorted_slots),
+        "sorted_row": jnp.asarray(plan.sorted_row),
+        "sorted_mask": jnp.asarray(plan.sorted_mask),
+        "sorted_fields": jnp.asarray(plan.sorted_fields),
+        "win_off": jnp.asarray(plan.win_off),
+    }
+    out_r = model.forward({"v": jnp.asarray(v)}, base, cfg)
+    out_s = model.forward({"v": jnp.asarray(v)}, srt, cfg)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r), rtol=1e-4, atol=1e-6)
+
+    opt = get_optimizer("ftrl")
+    step = make_train_step(model, opt, cfg)
+    t0 = {"v": jnp.asarray(v)}
+    s_r, m_r = step(TrainState(t0, opt.init_state(t0), jnp.zeros((), jnp.int32)), base)
+    t1 = {"v": jnp.asarray(v)}
+    s_s, m_s = step(TrainState(t1, opt.init_state(t1), jnp.zeros((), jnp.int32)), srt)
+    assert float(m_r["loss"]) == pytest.approx(float(m_s["loss"]), rel=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s_s.tables["v"]), np.asarray(s_r.tables["v"]), rtol=1e-4, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("model_name", ["fm", "mvm"])
+def test_stacked_sub_batches_match_single_plan(model_name):
+    """NS>1 (cache-resident sub-batching) is numerically identical to
+    NS=1: same logits, same one-step table update."""
+    from xflow_tpu.ops.sorted_table import plan_sorted_stacked
+    from xflow_tpu.optim import get_optimizer
+    from xflow_tpu.train.state import TrainState
+    from xflow_tpu.train.step import make_train_step
+
+    cfg = override(Config(), **{"data.log2_slots": 12, "model.name": model_name,
+                                "model.v_dim": 3, "model.num_fields": 4,
+                                "data.max_nnz": 6})
+    model = get_model(model_name)
+    rng = np.random.default_rng(13)
+    B, F = 32, 6
+    slots = rng.integers(0, S, (B, F)).astype(np.int32)
+    fields = rng.integers(0, 4, (B, F)).astype(np.int32)
+    mask = (rng.random((B, F)) < 0.8).astype(np.float32)
+    tdim = 4 if model_name == "fm" else 3
+    tname = "wv" if model_name == "fm" else "v"
+    tab = (rng.normal(size=(S, tdim)) * 0.1).astype(np.float32)
+    base = {
+        "slots": jnp.asarray(slots), "fields": jnp.asarray(fields),
+        "mask": jnp.asarray(mask),
+        "labels": jnp.asarray((rng.random(B) < 0.5).astype(np.float32)),
+        "row_mask": jnp.ones((B,), jnp.float32),
+    }
+    use_fields = fields if model_name == "mvm" else None
+
+    def arrays(ns):
+        p = plan_sorted_stacked(slots, mask, S, fields=use_fields, num_sub=ns)
+        out = {**base, "sorted_slots": jnp.asarray(p.sorted_slots),
+               "sorted_row": jnp.asarray(p.sorted_row),
+               "sorted_mask": jnp.asarray(p.sorted_mask),
+               "win_off": jnp.asarray(p.win_off)}
+        if use_fields is not None:
+            out["sorted_fields"] = jnp.asarray(p.sorted_fields)
+        return out
+
+    a1, a4 = arrays(1), arrays(4)
+    assert a4["sorted_slots"].ndim == 2 and a4["sorted_slots"].shape[0] == 4
+    out1 = model.forward({tname: jnp.asarray(tab)}, a1, cfg)
+    out4 = model.forward({tname: jnp.asarray(tab)}, a4, cfg)
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(out1), rtol=1e-5, atol=1e-7)
+
+    opt = get_optimizer("ftrl")
+    step = make_train_step(model, opt, cfg)
+    s1, _ = step(TrainState({tname: jnp.asarray(tab)},
+                            opt.init_state({tname: jnp.asarray(tab)}),
+                            jnp.zeros((), jnp.int32)), a1)
+    s4, _ = step(TrainState({tname: jnp.asarray(tab)},
+                            opt.init_state({tname: jnp.asarray(tab)}),
+                            jnp.zeros((), jnp.int32)), a4)
+    np.testing.assert_allclose(
+        np.asarray(s4.tables[tname]), np.asarray(s1.tables[tname]),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_trainer_sorted_layout_mvm_matches_off(tmp_path):
+    from xflow_tpu.data.synth import generate_shards
+    from xflow_tpu.train.trainer import Trainer
+
+    generate_shards(str(tmp_path / "train"), 1, 300, num_fields=5, ids_per_field=60, seed=11)
+
+    def run(sorted_layout):
+        cfg = override(
+            Config(),
+            **{
+                "data.train_path": str(tmp_path / "train"),
+                "data.test_path": str(tmp_path / "train"),
+                "data.log2_slots": 12,
+                "data.batch_size": 50,
+                "data.max_nnz": 8,
+                "data.sorted_layout": sorted_layout,
+                "model.name": "mvm",
+                "model.num_fields": 5,
+                "train.epochs": 2,
+                "train.pred_dump": False,
+            },
+        )
+        t = Trainer(cfg)
+        assert t._sorted == (sorted_layout == "on")
+        t.fit()
+        return t
+
+    t_on, t_off = run("on"), run("off")
+    np.testing.assert_allclose(
+        np.asarray(t_on.state.tables["v"]), np.asarray(t_off.state.tables["v"]),
+        rtol=1e-4, atol=1e-6,
+    )
+    auc_on, _ = t_on.evaluate()
+    auc_off, _ = t_off.evaluate()
+    assert auc_on == pytest.approx(auc_off, abs=1e-6)
+
+
 @pytest.mark.parametrize("standard", [True, False])
 def test_fm_sorted_forward_and_step_match_rowmajor(standard):
     from xflow_tpu.optim import get_optimizer

@@ -110,10 +110,17 @@ class DataConfig:
     # reassembled in file order).
     parser_threads: int = 0
     # sorted-window table layout (ops/sorted_table.py): "auto" enables it
-    # for single-device fused-FM training (where the windowed MXU
+    # for single-device fused-FM and MVM training (where the windowed MXU
     # gather/scatter replaces latency-bound random HBM access); "on"/"off"
     # force it. Identical math either way (equality-tested).
     sorted_layout: str = "auto"
+    # sub-batches per step for the sorted layout: the forward maps over
+    # row-contiguous sub-batches so per-row aggregates stay cache-resident
+    # (matters for MVM's [B·nf, k]); the optimizer still updates once per
+    # batch, so the math is NS-invariant. 0 = auto (1 for FM; for MVM the
+    # smallest power of two keeping B/NS·nf·(k+1)·4B under 16 MiB — the
+    # measured sweet spot on v5e, docs/PERF.md).
+    sorted_sub_batches: int = 0
 
 
 @dataclass(frozen=True)

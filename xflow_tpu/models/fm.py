@@ -57,28 +57,20 @@ def _second_order(vg, cfg):
     return second
 
 
-def _forward_sorted(tables, batch, cfg):
-    """Sorted-window path (ops/sorted_table.py): occurrences arrive
-    slot-sorted from the host; the table gather/scatter streams W-slot
-    windows with MXU one-hot matmuls (no random HBM access at table
-    scale) and per-row sums cross through small [B, k] segment arrays."""
+def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows, cfg):
     import jax
 
     from xflow_tpu.ops.sorted_table import table_gather_sorted
 
-    wv = tables["wv"]
     K = wv.shape[1]
-    occ_t = table_gather_sorted(wv, batch["sorted_slots"], batch["win_off"])  # [K8, Np]
-    m = batch["sorted_mask"]
-    row = batch["sorted_row"]
+    occ_t = table_gather_sorted(wv, sorted_slots, win_off)  # [K8, Np]
     # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
-    occm_t = occ_t[:K] * m[None, :]
-    B = batch["labels"].shape[0]
-    sums_t = jax.vmap(lambda r: jax.ops.segment_sum(r, row, num_segments=B))(
+    occm_t = occ_t[:K] * sorted_mask[None, :]
+    sums_t = jax.vmap(lambda r: jax.ops.segment_sum(r, sorted_row, num_segments=rows))(
         jnp.concatenate([occm_t, occm_t[1:] ** 2], axis=0)
-    )  # [2K-1, B]
+    )  # [2K-1, rows]
     wx = sums_t[0]
-    s, q = sums_t[1:K], sums_t[K:]  # [k, B] each
+    s, q = sums_t[1:K], sums_t[K:]  # [k, rows] each
     if cfg.model.fm_standard:
         second = (s * s - q).sum(axis=0)
         if cfg.model.fm_half:
@@ -87,6 +79,25 @@ def _forward_sorted(tables, batch, cfg):
         s_all, q_all = s.sum(axis=0), q.sum(axis=0)
         second = s_all * s_all - q_all
     return wx + second
+
+
+def _forward_sorted(tables, batch, cfg):
+    """Sorted-window path (ops/sorted_table.py): occurrences arrive
+    slot-sorted from the host; the table gather/scatter streams W-slot
+    windows with MXU one-hot matmuls (no random HBM access at table
+    scale) and per-row sums cross through small [B, k] segment arrays.
+    Sorted arrays may arrive stacked [NS, Np_sub] (plan_sorted_stacked):
+    map over row-contiguous sub-batches, same math (FM's row state is
+    already cache-resident at NS=1, so auto keeps NS=1)."""
+    from xflow_tpu.ops.sorted_table import map_sub_batches
+
+    wv = tables["wv"]
+    return map_sub_batches(
+        lambda ss, sr, sm, wo, rows: _forward_sorted_one(wv, ss, sr, sm, wo, rows, cfg),
+        batch,
+        ("sorted_slots", "sorted_row", "sorted_mask", "win_off"),
+        batch["labels"].shape[0],
+    )
 
 
 def forward(tables, batch, cfg):

@@ -36,7 +36,57 @@ def _table_specs(cfg):
     return {"v": (cfg.model.v_dim,)}
 
 
+def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
+                        win_off, rows, nf):
+    """One sub-batch: [K8, Np] windowed gather + one segment-sum keyed on
+    `row * nf + field` → logits [rows]."""
+    from xflow_tpu.ops.sorted_table import table_gather_sorted
+
+    k = v.shape[1]
+    seg = sorted_row * nf + sorted_fields  # [Np]
+    occ_t = table_gather_sorted(v, sorted_slots, win_off)  # [K8, Np]
+    occm_t = occ_t[:k] * sorted_mask[None, :]
+    # stack the mask as one extra channel: its segment-sum is the
+    # per-(row, field) occurrence count, giving `present` in the same op
+    stacked = jnp.concatenate([occm_t, sorted_mask[None, :]], axis=0)  # [k+1, Np]
+    sums_t = jax.vmap(
+        lambda r: jax.ops.segment_sum(r, seg, num_segments=rows * nf)
+    )(stacked)  # [k+1, rows*nf]
+    s = sums_t[:k].reshape(k, rows, nf)
+    present = (sums_t[k] > 0).reshape(rows, nf)
+    factors = jnp.where(present[None, :, :], s, 1.0)  # [k, rows, nf]
+    return jnp.prod(factors, axis=-1).sum(axis=0)  # [rows]
+
+
+def _forward_sorted(tables, batch, cfg):
+    """Sorted-window path (ops/sorted_table.py): the v-table gather and
+    its gradient scatter stream slot windows through the Pallas one-hot
+    MXU kernels; the per-(row, field) view sums become one segment-sum
+    keyed on `row * num_fields + field`.
+
+    MVM's row-side aggregate is [B·nf, k] — ~47 MB at B=64k — which
+    falls out of cache residency and makes the segment-sum/its backward
+    gather ~8× slower per element (docs/PERF.md). Sorted arrays may
+    therefore arrive STACKED [NS, Np_sub] (`plan_sorted_stacked`): the
+    forward maps over row-contiguous sub-batches whose [B/NS·nf, k]
+    aggregates stay resident, and XLA accumulates the table cotangent
+    across the map. Semantics are identical to NS=1 (row order is
+    preserved; the loss/optimizer still see one batch)."""
+    from xflow_tpu.ops.sorted_table import map_sub_batches
+
+    v = tables["v"]
+    nf = cfg.model.num_fields
+    return map_sub_batches(
+        lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(v, ss, sr, sm, sf, wo, rows, nf),
+        batch,
+        ("sorted_slots", "sorted_row", "sorted_mask", "sorted_fields", "win_off"),
+        batch["labels"].shape[0],
+    )
+
+
 def forward(tables, batch, cfg):
+    if "sorted_slots" in batch:
+        return _forward_sorted(tables, batch, cfg)
     v = tables["v"]
     nf = cfg.model.num_fields
     mask = batch["mask"]

@@ -38,7 +38,7 @@ Two implementations with identical semantics:
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -68,17 +68,24 @@ class SortedPlan(NamedTuple):
     sorted_row: np.ndarray  # int32 [Np]
     sorted_mask: np.ndarray  # float32 [Np]
     win_off: np.ndarray  # int32 [S/WINDOW + 1]
+    sorted_fields: Optional[np.ndarray] = None  # int32 [Np] (MVM; pad 0)
 
 
 def padded_len(n: int) -> int:
     return (n // CHUNK + 2) * CHUNK
 
 
-def plan_sorted_batch(slots: np.ndarray, mask: np.ndarray, num_slots: int) -> SortedPlan:
+def plan_sorted_batch(
+    slots: np.ndarray,
+    mask: np.ndarray,
+    num_slots: int,
+    fields: Optional[np.ndarray] = None,
+) -> SortedPlan:
     """Sort a [B, F] batch's occurrences by table slot (host side).
 
     Masked occurrences keep their (meaningless) slot — their mask rides
     along and zeroes both the forward contribution and the gradient.
+    `fields` (MVM) rides through the same permutation when given.
     """
     flat_slots = np.ascontiguousarray(slots, np.int32).ravel()
     flat_mask = np.ascontiguousarray(mask, np.float32).ravel()
@@ -91,13 +98,97 @@ def plan_sorted_batch(slots: np.ndarray, mask: np.ndarray, num_slots: int) -> So
     # the full padded array is sorted and the last window's range covers
     # every padded position — nothing is left unwritten by the kernels
     win_off = np.searchsorted(ss, np.arange(0, num_slots + 1, WINDOW)).astype(np.int32)
+    sorted_fields = None
+    if fields is not None:
+        flat_fields = np.ascontiguousarray(fields, np.int32).ravel()
+        sorted_fields = np.concatenate([flat_fields[order], np.zeros(pad, np.int32)])
     return SortedPlan(
         sorted_slots=ss,
         sorted_row=np.concatenate([(order // slots.shape[1]).astype(np.int32),
                                    np.zeros(pad, np.int32)]),
         sorted_mask=np.concatenate([flat_mask[order], np.zeros(pad, np.float32)]),
         win_off=win_off,
+        sorted_fields=sorted_fields,
     )
+
+
+def plan_sorted_stacked(
+    slots: np.ndarray,
+    mask: np.ndarray,
+    num_slots: int,
+    fields: Optional[np.ndarray] = None,
+    num_sub: int = 1,
+) -> SortedPlan:
+    """Per-sub-batch sorted plans, stacked on a leading [NS] axis.
+
+    Splits the [B, F] batch into `num_sub` row-contiguous sub-batches and
+    plans each independently (row ids are LOCAL to the sub-batch). The
+    device step maps over the NS axis, so per-row aggregates are sized
+    [B/NS, ...] — small enough to stay cache-resident for models whose
+    row-side state is large (MVM's [B·nf, k]); XLA accumulates the table
+    gradient across sub-batches. `B % num_sub == 0` is required (the
+    planner's callers pick a divisor).
+    """
+    B = slots.shape[0]
+    if num_sub <= 1:
+        return plan_sorted_batch(slots, mask, num_slots, fields=fields)
+    if B % num_sub:
+        raise ValueError(f"batch {B} not divisible by num_sub {num_sub}")
+    bs = B // num_sub
+    plans = [
+        plan_sorted_batch(
+            slots[i * bs : (i + 1) * bs],
+            mask[i * bs : (i + 1) * bs],
+            num_slots,
+            fields=None if fields is None else fields[i * bs : (i + 1) * bs],
+        )
+        for i in range(num_sub)
+    ]
+    return SortedPlan(
+        sorted_slots=np.stack([p.sorted_slots for p in plans]),
+        sorted_row=np.stack([p.sorted_row for p in plans]),
+        sorted_mask=np.stack([p.sorted_mask for p in plans]),
+        win_off=np.stack([p.win_off for p in plans]),
+        sorted_fields=(
+            np.stack([p.sorted_fields for p in plans]) if fields is not None else None
+        ),
+    )
+
+
+def map_sub_batches(fn, batch: dict, keys: tuple, batch_rows: int):
+    """Dispatch a sorted-path forward over flat or stacked plans.
+
+    `fn(*arrays, rows)` computes logits for one sub-batch from the
+    per-occurrence arrays named by `keys`. Flat ([Np]) plans call it
+    once; stacked ([NS, Np_sub], `plan_sorted_stacked`) map it over the
+    row-contiguous sub-batches and re-concatenate — row order is
+    preserved, so the result is NS-invariant.
+    """
+    arrs = tuple(batch[k] for k in keys)
+    if arrs[0].ndim == 1:
+        return fn(*arrs, batch_rows)
+    ns = arrs[0].shape[0]
+    rows = batch_rows // ns
+    logits = jax.lax.map(lambda a: fn(*a, rows), arrs)  # [NS, rows]
+    return logits.reshape(batch_rows)
+
+
+def auto_sub_batches(batch_size: int, row_state_bytes_per_row: int,
+                     target_bytes: int = 1 << 24) -> int:
+    """Smallest power-of-two NS (dividing batch_size) that keeps the
+    per-sub-batch row-side state under `target_bytes`; capped so
+    sub-batches keep >= 1024 rows. 16 MiB measured best on v5e for MVM
+    at B=64k/nf=18/k=10 (NS=4 → 396k ex/s; NS=1 252k, NS=16 210k —
+    smaller sub-batches pay window fragmentation in the table kernels,
+    larger ones fall out of cache on the row side; docs/PERF.md)."""
+    ns = 1
+    while (
+        batch_size % (ns * 2) == 0
+        and batch_size // ns > 1024
+        and (batch_size // ns) * row_state_bytes_per_row > target_bytes
+    ):
+        ns *= 2
+    return ns
 
 
 # ------------------------------------------------------------------ XLA path

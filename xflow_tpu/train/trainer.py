@@ -64,6 +64,29 @@ class MetricsLogger:
             self._f.close()
 
 
+def resolve_sub_batches(cfg: Config) -> int:
+    """NS for the sorted layout (cfg.data.sorted_sub_batches; 0 = auto).
+
+    Auto keeps MVM's per-sub-batch [B/NS·nf, k+1] row aggregate under
+    16 MiB (the measured v5e sweet spot — docs/PERF.md); FM's [B, 21] is
+    already small, so NS=1.
+    """
+    ns = cfg.data.sorted_sub_batches
+    B = cfg.data.batch_size
+    if ns > 0:
+        if B % ns:
+            raise ValueError(
+                f"data.sorted_sub_batches={ns} must divide batch_size={B}"
+            )
+        return ns
+    if cfg.model.name == "mvm":
+        from xflow_tpu.ops.sorted_table import auto_sub_batches
+
+        per_row = cfg.model.num_fields * (cfg.model.v_dim + 1) * 4
+        return auto_sub_batches(B, per_row)
+    return 1
+
+
 class Trainer:
     def __init__(self, cfg: Config, mesh=None, process_index: int = 0):
         self.cfg = cfg
@@ -87,12 +110,14 @@ class Trainer:
             self._shard_batch = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
         self.metrics = MetricsLogger(cfg.train.metrics_path)
         # sorted-window table layout (ops/sorted_table.py): single-device
-        # fused-FM only — the mesh path keeps XLA gather/scatter (GSPMD
-        # owns cross-chip layout there)
+        # fused-FM and MVM — the mesh path keeps XLA gather/scatter
+        # (GSPMD owns cross-chip layout there)
         from xflow_tpu.ops.sorted_table import WINDOW
 
         sl = cfg.data.sorted_layout
-        supported = cfg.model.name == "fm" and cfg.model.fm_fused and mesh is None
+        supported = mesh is None and (
+            (cfg.model.name == "fm" and cfg.model.fm_fused) or cfg.model.name == "mvm"
+        )
         self._sorted = sl == "on" or (
             sl == "auto" and supported and cfg.num_slots % WINDOW == 0
         )
@@ -103,8 +128,9 @@ class Trainer:
             if not supported:
                 raise ValueError(
                     "sorted_layout=on requires model.name=fm with "
-                    "model.fm_fused=true on a single device (mesh=None); "
-                    f"got model={cfg.model.name} fm_fused={cfg.model.fm_fused} "
+                    "model.fm_fused=true, or model.name=mvm, on a single "
+                    f"device (mesh=None); got model={cfg.model.name} "
+                    f"fm_fused={cfg.model.fm_fused} "
                     f"mesh={'set' if mesh is not None else 'None'}"
                 )
             if cfg.num_slots % WINDOW != 0:
@@ -112,6 +138,7 @@ class Trainer:
                     f"sorted_layout=on needs num_slots divisible by {WINDOW}; "
                     f"got 2^{cfg.data.log2_slots}"
                 )
+        self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
         # MVM keys its views on the field id: a field >= num_fields would be
         # silently dropped by the one-hot, so reject it loudly
         self._validate_fields = cfg.model.name == "mvm"
@@ -129,10 +156,15 @@ class Trainer:
         """SparseBatch -> step input arrays (+ sorted-layout plan)."""
         arrays = batch_to_arrays(batch)
         if self._sorted:
-            from xflow_tpu.ops.sorted_table import plan_sorted_batch
+            from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
-            plan = plan_sorted_batch(
-                np.asarray(batch.slots), np.asarray(batch.mask), self.cfg.num_slots
+            mvm = self.cfg.model.name == "mvm"
+            plan = plan_sorted_stacked(
+                np.asarray(batch.slots),
+                np.asarray(batch.mask),
+                self.cfg.num_slots,
+                fields=np.asarray(batch.fields) if mvm else None,
+                num_sub=self._sorted_sub,
             )
             arrays.update(
                 sorted_slots=plan.sorted_slots,
@@ -140,6 +172,8 @@ class Trainer:
                 sorted_mask=plan.sorted_mask,
                 win_off=plan.win_off,
             )
+            if mvm:
+                arrays["sorted_fields"] = plan.sorted_fields
         return arrays
 
     # -------------------------------------------------------- multi-process IO
