@@ -127,7 +127,8 @@ def test_pallas_interpret_matches_xla(seed):
     np.testing.assert_allclose(np.asarray(dt_p), np.asarray(dt_x), rtol=1e-5, atol=1e-6)
 
 
-def test_trainer_sorted_layout_matches_off(tmp_path):
+@pytest.mark.parametrize("model_name, table", [("fm", "wv"), ("mvm", "v")])
+def test_trainer_sorted_layout_matches_off(tmp_path, model_name, table):
     # end-to-end: identical final tables and AUC with the layout on vs off
     from xflow_tpu.data.synth import generate_shards
     from xflow_tpu.train.trainer import Trainer
@@ -144,7 +145,7 @@ def test_trainer_sorted_layout_matches_off(tmp_path):
                 "data.batch_size": 50,
                 "data.max_nnz": 8,
                 "data.sorted_layout": sorted_layout,
-                "model.name": "fm",
+                "model.name": model_name,
                 "model.num_fields": 5,
                 "train.epochs": 2,
                 "train.pred_dump": False,
@@ -157,7 +158,7 @@ def test_trainer_sorted_layout_matches_off(tmp_path):
 
     t_on, t_off = run("on"), run("off")
     np.testing.assert_allclose(
-        np.asarray(t_on.state.tables["wv"]), np.asarray(t_off.state.tables["wv"]),
+        np.asarray(t_on.state.tables[table]), np.asarray(t_off.state.tables[table]),
         rtol=1e-4, atol=1e-6,
     )
     auc_on, _ = t_on.evaluate()
@@ -279,43 +280,6 @@ def test_stacked_sub_batches_match_single_plan(model_name):
         np.asarray(s4.tables[tname]), np.asarray(s1.tables[tname]),
         rtol=1e-4, atol=1e-6,
     )
-
-
-def test_trainer_sorted_layout_mvm_matches_off(tmp_path):
-    from xflow_tpu.data.synth import generate_shards
-    from xflow_tpu.train.trainer import Trainer
-
-    generate_shards(str(tmp_path / "train"), 1, 300, num_fields=5, ids_per_field=60, seed=11)
-
-    def run(sorted_layout):
-        cfg = override(
-            Config(),
-            **{
-                "data.train_path": str(tmp_path / "train"),
-                "data.test_path": str(tmp_path / "train"),
-                "data.log2_slots": 12,
-                "data.batch_size": 50,
-                "data.max_nnz": 8,
-                "data.sorted_layout": sorted_layout,
-                "model.name": "mvm",
-                "model.num_fields": 5,
-                "train.epochs": 2,
-                "train.pred_dump": False,
-            },
-        )
-        t = Trainer(cfg)
-        assert t._sorted == (sorted_layout == "on")
-        t.fit()
-        return t
-
-    t_on, t_off = run("on"), run("off")
-    np.testing.assert_allclose(
-        np.asarray(t_on.state.tables["v"]), np.asarray(t_off.state.tables["v"]),
-        rtol=1e-4, atol=1e-6,
-    )
-    auc_on, _ = t_on.evaluate()
-    auc_off, _ = t_off.evaluate()
-    assert auc_on == pytest.approx(auc_off, abs=1e-6)
 
 
 @pytest.mark.parametrize("standard", [True, False])

@@ -184,11 +184,32 @@ def auto_sub_batches(batch_size: int, row_state_bytes_per_row: int,
     ns = 1
     while (
         batch_size % (ns * 2) == 0
-        and batch_size // ns > 1024
+        and batch_size // (ns * 2) >= 1024
         and (batch_size // ns) * row_state_bytes_per_row > target_bytes
     ):
         ns *= 2
     return ns
+
+
+def resolve_sub_batches(cfg) -> int:
+    """NS for the sorted layout (cfg.data.sorted_sub_batches; 0 = auto).
+
+    Auto keeps MVM's per-sub-batch [B/NS·nf, k+1] row aggregate under
+    16 MiB (the measured v5e sweet spot — docs/PERF.md); FM's [B, 21] is
+    already small, so NS=1.
+    """
+    ns = cfg.data.sorted_sub_batches
+    B = cfg.data.batch_size
+    if ns > 0:
+        if B % ns:
+            raise ValueError(
+                f"data.sorted_sub_batches={ns} must divide batch_size={B}"
+            )
+        return ns
+    if cfg.model.name == "mvm":
+        per_row = cfg.model.num_fields * (cfg.model.v_dim + 1) * 4
+        return auto_sub_batches(B, per_row)
+    return 1
 
 
 # ------------------------------------------------------------------ XLA path

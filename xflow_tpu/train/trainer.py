@@ -64,29 +64,6 @@ class MetricsLogger:
             self._f.close()
 
 
-def resolve_sub_batches(cfg: Config) -> int:
-    """NS for the sorted layout (cfg.data.sorted_sub_batches; 0 = auto).
-
-    Auto keeps MVM's per-sub-batch [B/NS·nf, k+1] row aggregate under
-    16 MiB (the measured v5e sweet spot — docs/PERF.md); FM's [B, 21] is
-    already small, so NS=1.
-    """
-    ns = cfg.data.sorted_sub_batches
-    B = cfg.data.batch_size
-    if ns > 0:
-        if B % ns:
-            raise ValueError(
-                f"data.sorted_sub_batches={ns} must divide batch_size={B}"
-            )
-        return ns
-    if cfg.model.name == "mvm":
-        from xflow_tpu.ops.sorted_table import auto_sub_batches
-
-        per_row = cfg.model.num_fields * (cfg.model.v_dim + 1) * 4
-        return auto_sub_batches(B, per_row)
-    return 1
-
-
 class Trainer:
     def __init__(self, cfg: Config, mesh=None, process_index: int = 0):
         self.cfg = cfg
@@ -138,6 +115,8 @@ class Trainer:
                     f"sorted_layout=on needs num_slots divisible by {WINDOW}; "
                     f"got 2^{cfg.data.log2_slots}"
                 )
+        from xflow_tpu.ops.sorted_table import resolve_sub_batches
+
         self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
         # MVM keys its views on the field id: a field >= num_fields would be
         # silently dropped by the one-hot, so reject it loudly
