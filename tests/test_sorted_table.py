@@ -127,6 +127,44 @@ def test_pallas_interpret_matches_xla(seed):
     np.testing.assert_allclose(np.asarray(dt_p), np.asarray(dt_x), rtol=1e-5, atol=1e-6)
 
 
+def test_native_plan_matches_numpy(monkeypatch):
+    """xf_plan_sorted (C radix sort) is bit-identical to the numpy
+    argsort planner — both stable, same pads, same win_off."""
+    pytest.importorskip("ctypes")
+    try:
+        from xflow_tpu.data.native import native_plan_sorted  # noqa: F401 — builds lib
+        from xflow_tpu.data.native import get_lib
+
+        get_lib()
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    import xflow_tpu.ops.sorted_table as st
+
+    rng = np.random.default_rng(21)
+    for B, F, with_fields in [(16, 8, False), (64, 8, True), (1, 1, False), (7, 3, True)]:
+        slots = rng.integers(0, S, (B, F)).astype(np.int32)
+        mask = (rng.random((B, F)) < 0.7).astype(np.float32)
+        fields = rng.integers(0, 6, (B, F)).astype(np.int32) if with_fields else None
+
+        monkeypatch.setattr(st, "_NATIVE_PLAN", None)
+        monkeypatch.setenv("XFLOW_NO_NATIVE_PLAN", "1")
+        py = st.plan_sorted_batch(slots, mask, S, fields=fields)
+        monkeypatch.delenv("XFLOW_NO_NATIVE_PLAN")
+        monkeypatch.setattr(st, "_NATIVE_PLAN", None)
+        nat = st.plan_sorted_batch(slots, mask, S, fields=fields)
+        assert st._NATIVE_PLAN, "native planner did not engage"
+
+        np.testing.assert_array_equal(nat.sorted_slots, py.sorted_slots)
+        np.testing.assert_array_equal(nat.sorted_row, py.sorted_row)
+        np.testing.assert_array_equal(nat.sorted_mask, py.sorted_mask)
+        np.testing.assert_array_equal(nat.win_off, py.win_off)
+        if with_fields:
+            np.testing.assert_array_equal(nat.sorted_fields, py.sorted_fields)
+        else:
+            assert nat.sorted_fields is None and py.sorted_fields is None
+    monkeypatch.setattr(st, "_NATIVE_PLAN", None)
+
+
 @pytest.mark.parametrize("model_name, table", [("fm", "wv"), ("mvm", "v")])
 def test_trainer_sorted_layout_matches_off(tmp_path, model_name, table):
     # end-to-end: identical final tables and AUC with the layout on vs off

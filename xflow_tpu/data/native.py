@@ -95,8 +95,74 @@ def get_lib() -> ctypes.CDLL:
         lib.xf_mt_truncated.argtypes = [ctypes.c_void_p]
         lib.xf_mt_close.restype = None
         lib.xf_mt_close.argtypes = [ctypes.c_void_p]
+        lib.xf_plan_sorted.restype = ctypes.c_long
+        lib.xf_plan_sorted.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _LIB = lib
     return _LIB
+
+
+def native_plan_sorted(slots, mask, fields, num_slots: int, window: int, np_len: int):
+    """C radix-sort plan builder (xf_plan_sorted). Returns the plan
+    arrays (sorted_slots, sorted_row, sorted_mask, sorted_fields|None,
+    win_off) or raises on toolchain/library failure. ctypes releases the
+    GIL during the call, so stacked sub-batch plans can run in parallel
+    host threads."""
+    lib = get_lib()
+    slots = np.ascontiguousarray(slots, np.int32)
+    mask_flat = np.ascontiguousarray(mask, np.float32).ravel()
+    B, F = slots.shape
+    n = B * F
+    # C reads n entries from each buffer: a size mismatch that would be a
+    # loud IndexError in the numpy path must not become an OOB heap read
+    if mask_flat.size != n:
+        raise ValueError(f"mask size {mask_flat.size} != slots size {n}")
+    if fields is not None and np.asarray(fields).size != n:
+        raise ValueError(f"fields size {np.asarray(fields).size} != slots size {n}")
+    out_slots = np.empty(np_len, np.int32)
+    out_row = np.empty(np_len, np.int32)
+    out_mask = np.empty(np_len, np.float32)
+    out_fields = np.empty(np_len, np.int32) if fields is not None else None
+    n_win = num_slots // window
+    win_off = np.empty(n_win + 1, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    fields_c = (
+        np.ascontiguousarray(fields, np.int32).ctypes.data_as(i32p)
+        if fields is not None
+        else None
+    )
+    rc = lib.xf_plan_sorted(
+        slots.ctypes.data_as(i32p),
+        mask_flat.ctypes.data_as(f32p),
+        fields_c,
+        n,
+        F,
+        num_slots,
+        window,
+        np_len,
+        out_slots.ctypes.data_as(i32p),
+        out_row.ctypes.data_as(i32p),
+        out_mask.ctypes.data_as(f32p),
+        out_fields.ctypes.data_as(i32p) if out_fields is not None else None,
+        win_off.ctypes.data_as(i32p),
+    )
+    if rc != 0:
+        raise ValueError(f"xf_plan_sorted failed (rc={rc})")
+    return out_slots, out_row, out_mask, out_fields, win_off
 
 
 def native_count_rows(path: str, block_bytes: int) -> int:

@@ -537,3 +537,85 @@ long xf_count_rows(const char* path, long block_bytes) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Sorted-window plan builder (ops/sorted_table.py host side).
+//
+// Stable LSD radix sort of a batch's feature occurrences by table slot,
+// emitting the padded arrays the Pallas sorted-window kernels consume.
+// np.argsort(kind="stable") on 2M occurrences costs ~150 ms in the
+// Python planner — enough to wall the host data plane at the step times
+// the sorted engine reaches; this builder is O(n) per 11-bit digit
+// (2 passes at log2_slots <= 22).
+//
+// Output contract matches plan_sorted_batch exactly (parity-tested):
+//   - out arrays have np_len entries; pads carry slot num_slots-1,
+//     row/field 0, mask 0
+//   - out_win_off[w] = first sorted position with slot >= w*window,
+//     w in [0, num_slots/window]; pads are owned by the last window
+//   - stability: equal slots keep original (row-major) occurrence order
+
+extern "C" {
+
+long xf_plan_sorted(const int32_t* slots, const float* mask, const int32_t* fields,
+                    long n, long nnz_per_row, long num_slots, long window,
+                    long np_len, int32_t* out_slots, int32_t* out_row,
+                    float* out_mask, int32_t* out_fields, int32_t* out_win_off) {
+  if (n < 0 || np_len < n || nnz_per_row <= 0 || num_slots <= 0 || window <= 0 ||
+      num_slots % window != 0) {
+    return -1;
+  }
+  constexpr int kDigitBits = 11;
+  constexpr int kRadix = 1 << kDigitBits;
+  std::vector<int32_t> order(n), scratch(n);
+  for (long i = 0; i < n; ++i) order[i] = static_cast<int32_t>(i);
+  int bits = 0;
+  while ((1L << bits) < num_slots) ++bits;
+  int32_t* cur = order.data();
+  int32_t* nxt = scratch.data();
+  long hist[kRadix + 1];
+  for (int shift = 0; shift < bits; shift += kDigitBits) {
+    memset(hist, 0, sizeof(hist));
+    for (long i = 0; i < n; ++i) {
+      ++hist[(static_cast<uint32_t>(slots[cur[i]]) >> shift) & (kRadix - 1)];
+    }
+    long sum = 0;
+    for (int d = 0; d < kRadix; ++d) {
+      long c = hist[d];
+      hist[d] = sum;
+      sum += c;
+    }
+    for (long i = 0; i < n; ++i) {
+      uint32_t d = (static_cast<uint32_t>(slots[cur[i]]) >> shift) & (kRadix - 1);
+      nxt[hist[d]++] = cur[i];
+    }
+    int32_t* t = cur;
+    cur = nxt;
+    nxt = t;
+  }
+  for (long i = 0; i < n; ++i) {
+    int32_t src = cur[i];
+    out_slots[i] = slots[src];
+    out_row[i] = static_cast<int32_t>(src / nnz_per_row);
+    out_mask[i] = mask[src];
+    if (out_fields != nullptr) out_fields[i] = fields[src];
+  }
+  for (long i = n; i < np_len; ++i) {
+    out_slots[i] = static_cast<int32_t>(num_slots - 1);
+    out_row[i] = 0;
+    out_mask[i] = 0.0f;
+    if (out_fields != nullptr) out_fields[i] = 0;
+  }
+  // win_off by linear scan over the sorted (padded) slots
+  long n_win = num_slots / window;
+  long pos = 0;
+  out_win_off[0] = 0;
+  for (long w = 1; w <= n_win; ++w) {
+    long bound = w * window;
+    while (pos < np_len && out_slots[pos] < bound) ++pos;
+    out_win_off[w] = static_cast<int32_t>(pos);
+  }
+  return 0;
+}
+
+}  // extern "C"

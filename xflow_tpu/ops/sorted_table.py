@@ -75,6 +75,31 @@ def padded_len(n: int) -> int:
     return (n // CHUNK + 2) * CHUNK
 
 
+_NATIVE_PLAN = None  # tri-state: None = untried, False = unavailable, else fn
+
+
+def _native_planner():
+    """xf_plan_sorted via ctypes (native/parser.cc): a stable O(n) radix
+    sort replacing np.argsort's ~150 ms/2M-occurrence comparison sort —
+    the host would otherwise wall the sorted-engine step times. Falls
+    back to numpy when the toolchain is missing. XFLOW_NO_NATIVE_PLAN=1
+    forces the numpy path (used by the parity tests)."""
+    global _NATIVE_PLAN
+    if _NATIVE_PLAN is None:
+        import os
+
+        if os.environ.get("XFLOW_NO_NATIVE_PLAN"):
+            _NATIVE_PLAN = False
+        else:
+            try:
+                from xflow_tpu.data.native import native_plan_sorted
+
+                _NATIVE_PLAN = native_plan_sorted
+            except Exception:
+                _NATIVE_PLAN = False
+    return _NATIVE_PLAN
+
+
 def plan_sorted_batch(
     slots: np.ndarray,
     mask: np.ndarray,
@@ -86,7 +111,21 @@ def plan_sorted_batch(
     Masked occurrences keep their (meaningless) slot — their mask rides
     along and zeroes both the forward contribution and the gradient.
     `fields` (MVM) rides through the same permutation when given.
+    Uses the C radix-sort builder when built (bit-identical to the numpy
+    path — both sorts are stable; parity-tested).
     """
+    native = _native_planner()
+    if native and num_slots % WINDOW == 0:
+        # no try/except: the numpy fallback exists for a MISSING toolchain
+        # (handled once at load in _native_planner); a runtime failure in a
+        # successfully-built planner is a bug and must raise, not silently
+        # re-run the 4x-slower argsort on every batch
+        ss, row, m, f, off = native(
+            np.ascontiguousarray(slots, np.int32),
+            mask, fields, num_slots, WINDOW,
+            padded_len(slots.size),
+        )
+        return SortedPlan(ss, row, m, off, f)
     flat_slots = np.ascontiguousarray(slots, np.int32).ravel()
     flat_mask = np.ascontiguousarray(mask, np.float32).ravel()
     n = flat_slots.shape[0]

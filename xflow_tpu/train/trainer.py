@@ -192,30 +192,39 @@ class Trainer:
         counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
         return int(counts.max()), local
 
+    def _with_arrays(self, batch):
+        """(batch, step-input arrays) — validation + sorted-plan building
+        happen HERE so that, wrapped in `prefetch`, the host-side sort
+        overlaps device compute instead of serializing with dispatch."""
+        self._check_batch(batch)
+        return batch, self._batch_arrays(batch)
+
     def _coordinated_batches(self, path: str):
-        """Yield exactly the globally-agreed number of batches for `path`,
-        padding with fully-masked empty batches once local input is
-        exhausted. Collective-free on the host side after the one counting
-        allgather (cached across epochs)."""
+        """Yield exactly the globally-agreed number of (batch, arrays)
+        pairs for `path`, padding with fully-masked empty batches once
+        local input is exhausted. Collective-free on the host side after
+        the one counting allgather (cached across epochs)."""
         if jax.process_count() == 1:
-            yield from prefetch(batch_iterator(path, self.cfg.data))
+            yield from prefetch(
+                map(self._with_arrays, batch_iterator(path, self.cfg.data))
+            )
             return
         global_steps, local = self._global_batch_count(path)
         # open the real iterator whenever the file exists (even if counted
         # 0) so the drift check below can catch a counter that under-reads
         it = (
-            iter(prefetch(batch_iterator(path, self.cfg.data)))
+            iter(prefetch(map(self._with_arrays, batch_iterator(path, self.cfg.data))))
             if os.path.exists(path)
             else iter(())
         )
         produced = 0
         for _ in range(global_steps):
-            batch = next(it, None)
-            if batch is None:
-                batch = self._empty_batch()
+            pair = next(it, None)
+            if pair is None:
+                pair = self._with_arrays(self._empty_batch())
             else:
                 produced += 1
-            yield batch
+            yield pair
         # loud drift check: if the counter mispredicted, data would be
         # silently dropped (under-count) or phantom empty steps run
         # (over-count) — either means the counter/parser predicates split
@@ -238,9 +247,8 @@ class Trainer:
         last_metrics = None
         try:
             for epoch in range(cfg.train.epochs):
-                for batch in self._coordinated_batches(path):
-                    self._check_batch(batch)
-                    arrays = self._shard_batch(self._batch_arrays(batch))
+                for batch, arrays in self._coordinated_batches(path):
+                    arrays = self._shard_batch(arrays)
                     self.state, m = self.train_step(self.state, arrays)
                     last_metrics = m
                     res.steps += 1
@@ -335,9 +343,8 @@ class Trainer:
         dump = dump and (not multiproc or self.rank == 0)
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
-        for batch in self._coordinated_batches(path):
-            self._check_batch(batch)
-            arrays = self._shard_batch(self._batch_arrays(batch))
+        for batch, arrays in self._coordinated_batches(path):
+            arrays = self._shard_batch(arrays)
             p_dev = self.eval_step(self.state.tables, arrays)
             if multiproc:
                 # ONE allgather of the stacked local rows per batch
@@ -387,9 +394,8 @@ class Trainer:
         neg = np.zeros(num_buckets, np.float64)
         ll_sum, n_rows = 0.0, 0.0
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
-        for batch in self._coordinated_batches(path):
-            self._check_batch(batch)
-            arrays = self._shard_batch(self._batch_arrays(batch))
+        for batch, arrays in self._coordinated_batches(path):
+            arrays = self._shard_batch(arrays)
             p = self._local_pctrs(self.eval_step(self.state.tables, arrays))
             rm = np.asarray(batch.row_mask) > 0
             y = np.asarray(batch.labels)[rm]
