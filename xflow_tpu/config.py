@@ -154,6 +154,13 @@ class TrainConfig:
     eval_buckets: int = 0
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
     profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
+    # preemption: on SIGTERM/SIGINT save a checkpoint at the next step
+    # boundary and return early (single-process; multi-process preemption
+    # relies on checkpoint_every cadence — a mid-loop signal-triggered
+    # collective save cannot be made rank-symmetric without per-step
+    # collectives). The reference loses all weights on any termination
+    # (SURVEY.md §5 A3: server state is in-memory only).
+    ckpt_on_signal: bool = True
 
 
 @dataclass(frozen=True)

@@ -85,6 +85,13 @@ def cmd_train(args) -> int:
         "last_loss": res.last_loss,
         "occupancy": res.occupancy,
     }
+    if res.interrupted:
+        # preempted: checkpoint was saved at the last step boundary; skip
+        # the eval pass and report, so the grace period isn't spent there
+        summary["interrupted"] = res.interrupted
+        if rank == 0:
+            print(json.dumps(summary))
+        return 0
     # reference: only rank 0 runs predict (lr_worker.cc:211-215); here the
     # eval contains collectives, so every process participates and rank 0
     # reports/dumps

@@ -42,6 +42,7 @@ class TrainResult:
     auc: float = float("nan")
     logloss: float = float("nan")
     occupancy: dict = field(default_factory=dict)
+    interrupted: int = 0  # signal number when preempted mid-run (A3)
 
     @property
     def examples_per_sec(self) -> float:
@@ -237,6 +238,44 @@ class Trainer:
             )
 
     # ------------------------------------------------------------------ train
+    def _install_signal_checkpoint(self):
+        """Preemption hook (train.ckpt_on_signal): SIGTERM/SIGINT set a
+        flag; the fit loop saves a checkpoint at the next step boundary
+        and returns early. Single-process, main-thread only (a signal-
+        triggered collective save cannot be rank-symmetric); the second
+        signal falls through to the previous handler, so a double Ctrl-C
+        still kills a stuck run. Reference comparison (SURVEY.md §5 A3):
+        any termination loses all server-side weights."""
+        import signal
+        import threading
+
+        cfg = self.cfg
+        if not (
+            cfg.train.ckpt_on_signal
+            and cfg.train.checkpoint_dir
+            and jax.process_count() == 1
+            and threading.current_thread() is threading.main_thread()
+        ):
+            return None, lambda: None
+        flag = {}
+        prev = {}
+
+        def handler(signum, frame):
+            flag["sig"] = signum
+            # restore immediately: a second signal acts normally
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            prev[s] = signal.signal(s, handler)
+
+        def restore():
+            if "sig" not in flag:
+                for s, h in prev.items():
+                    signal.signal(s, h)
+
+        return flag, restore
+
     def fit(self, train_path: Optional[str] = None) -> TrainResult:
         cfg = self.cfg
         path = train_path or shard_path(cfg.data.train_path, self.rank)
@@ -245,6 +284,11 @@ class Trainer:
         if cfg.train.profile_dir:
             jax.profiler.start_trace(cfg.train.profile_dir)
         last_metrics = None
+        sig_flag, sig_restore = self._install_signal_checkpoint()
+
+        def pending_signal() -> int:
+            return int(sig_flag["sig"]) if sig_flag and "sig" in sig_flag else 0
+
         try:
             for epoch in range(cfg.train.epochs):
                 for batch, arrays in self._coordinated_batches(path):
@@ -270,15 +314,30 @@ class Trainer:
                         and res.steps % cfg.train.checkpoint_every == 0
                     ):
                         self.save_checkpoint()
-                res.epochs = epoch + 1
-                if (epoch + 1) % 30 == 0:
-                    print(f"epoch : {epoch}", file=sys.stderr)
-                if cfg.train.eval_every and (epoch + 1) % cfg.train.eval_every == 0:
-                    auc, ll = self.evaluate(dump=False)
-                    self.metrics.log({"epoch": epoch, "eval_auc": auc, "eval_logloss": ll})
+                    if pending_signal():
+                        break
+                res.epochs = epoch + (0 if pending_signal() else 1)
+                if not pending_signal():
+                    if (epoch + 1) % 30 == 0:
+                        print(f"epoch : {epoch}", file=sys.stderr)
+                    if cfg.train.eval_every and (epoch + 1) % cfg.train.eval_every == 0:
+                        auc, ll = self.evaluate(dump=False)
+                        self.metrics.log({"epoch": epoch, "eval_auc": auc, "eval_logloss": ll})
+                # re-check AFTER the epoch eval too: a signal landing there
+                # (or between the last step and loop exit) must not be lost
+                if pending_signal():
+                    res.interrupted = pending_signal()
+                    self.metrics.log({"interrupted": res.interrupted, "step": res.steps})
+                    print(
+                        f"signal {res.interrupted}: checkpointing at step "
+                        f"{res.steps} and exiting",
+                        file=sys.stderr,
+                    )
+                    break
             if last_metrics is not None:
                 res.last_loss = float(last_metrics["loss"])
         finally:
+            sig_restore()
             if cfg.train.profile_dir:
                 jax.profiler.stop_trace()
         res.seconds = time.time() - start
