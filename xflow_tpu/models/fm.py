@@ -58,25 +58,28 @@ def _second_order(vg, cfg):
 
 
 def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows, cfg):
-    import jax
-
-    from xflow_tpu.ops.sorted_table import table_gather_sorted
+    from xflow_tpu.ops.sorted_table import _k8, row_sums_sorted, table_gather_sorted
 
     K = wv.shape[1]
     occ_t = table_gather_sorted(wv, sorted_slots, win_off)  # [K8, Np]
     # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
     occm_t = occ_t[:K] * sorted_mask[None, :]
-    sums_t = jax.vmap(lambda r: jax.ops.segment_sum(r, sorted_row, num_segments=rows))(
-        jnp.concatenate([occm_t, occm_t[1:] ** 2], axis=0)
-    )  # [2K-1, rows]
-    wx = sums_t[0]
-    s, q = sums_t[1:K], sums_t[K:]  # [k, rows] each
+    nch = 2 * K - 1  # w + k latents + k squares
+    ch = _k8(nch)  # row_sums_sorted wants a sublane multiple
+    stacked = jnp.concatenate(
+        [occm_t, occm_t[1:] ** 2,
+         jnp.zeros((ch - nch, occ_t.shape[1]), occ_t.dtype)],
+        axis=0,
+    )  # [ch, Np]
+    sums = row_sums_sorted(stacked, sorted_row, rows)  # [rows, ch]
+    wx = sums[:, 0]
+    s, q = sums[:, 1:K], sums[:, K:nch]  # [rows, k] each
     if cfg.model.fm_standard:
-        second = (s * s - q).sum(axis=0)
+        second = (s * s - q).sum(axis=-1)
         if cfg.model.fm_half:
             second = 0.5 * second
     else:
-        s_all, q_all = s.sum(axis=0), q.sum(axis=0)
+        s_all, q_all = s.sum(axis=-1), q.sum(axis=-1)
         second = s_all * s_all - q_all
     return wx + second
 

@@ -409,6 +409,100 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# ------------------------------------------------- row-sum kernel (fwd)
+
+def _rowsum_kernel_factory(num_rows, ch, chunk):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(rows_ref, vals_ref, out_ref, vchunk, vt_ref, rchunk, sem_v, sem_r):
+        n_chunks = vals_ref.shape[1] // chunk
+        out_ref[:, :] = jnp.zeros((num_rows, ch), jnp.float32)
+
+        def chunk_step(c, carry):
+            o = c * chunk
+            cp_r = pltpu.make_async_copy(rows_ref.at[:, pl.ds(o, chunk)], rchunk, sem_r)
+            cp_r.start()
+            cp_v = pltpu.make_async_copy(vals_ref.at[:, pl.ds(o, chunk)], vchunk, sem_v)
+            cp_v.start()
+            cp_r.wait()
+            cp_v.wait()
+            vt_ref[:, :] = vchunk[:, :].T  # [chunk, ch]: rows readable per i
+
+            def inner(i, carry2):
+                r = rchunk[0, i]
+                out_ref[pl.ds(r, 1), :] += vt_ref[pl.ds(i, 1), :]
+                return carry2
+
+            jax.lax.fori_loop(0, chunk, inner, 0, unroll=chunk)
+            return carry
+
+        jax.lax.fori_loop(0, n_chunks, chunk_step, 0)
+
+    return kernel
+
+
+def _rowsum_pallas(vals_t, rows, num_rows):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ch, n = vals_t.shape
+    assert n % CHUNK == 0, (n, CHUNK)
+    assert ch % 8 == 0, ch
+    return pl.pallas_call(
+        _rowsum_kernel_factory(num_rows, ch, CHUNK),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((num_rows, ch), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((num_rows, ch), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((ch, CHUNK), jnp.float32),
+            pltpu.VMEM((CHUNK, ch), jnp.float32),
+            pltpu.SMEM((1, CHUNK), jnp.int32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )(rows.reshape(1, n), vals_t)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def row_sums_sorted(vals_t, rows, num_rows):
+    """Σ over occurrences into rows: out[r, c] = Σ_{j: rows[j]=r} vals_t[c, j].
+
+    The occurrence→row reduction is the FM sorted-path wall (docs/PERF.md):
+    XLA's scatter runs ~24 ns/occurrence at bench shapes. On TPU this op is
+    a Pallas kernel holding the [num_rows, ch] accumulator VMEM-resident
+    and doing one dynamic-sublane read-modify-write per occurrence on the
+    scalar core (~15 ns measured, 1.6×) — viable only while
+    num_rows × 128 lanes × 4 B fits VMEM (num_rows ≤ ~64k), which is why
+    MVM's [B·nf] segment space keeps the XLA segment-sum instead.
+    Constraints: ch % 8 == 0, len(rows) % CHUNK == 0 (pad rows with 0 and
+    vals with 0 — pads accumulate zero into row 0). Differentiable in
+    `vals_t`; the VJP is the row gather d_out.T[:, rows]."""
+    # VMEM guard: the accumulator occupies num_rows × 128 lanes × 4 B
+    # regardless of ch (lane padding); 64k rows = 33.5 MB is measured to
+    # fit on v5e, 2× that failed to compile (tools/rowsum_probe.py) —
+    # larger batches fall back to the XLA segment-sum rather than dying
+    # in Mosaic
+    if _on_tpu() and num_rows <= 65536:
+        return _rowsum_pallas(vals_t, rows, num_rows)
+    return jax.ops.segment_sum(vals_t.T, rows, num_segments=num_rows)
+
+
+def _rowsum_fwd(vals_t, rows, num_rows):
+    return row_sums_sorted(vals_t, rows, num_rows), rows
+
+
+def _rowsum_bwd(num_rows, rows, d_out):
+    return jnp.take(d_out.T, rows, axis=1), None
+
+
+row_sums_sorted.defvjp(_rowsum_fwd, _rowsum_bwd)
+
+
 # ------------------------------------------------------------ public op
 
 @partial(jax.custom_vjp, nondiff_argnums=())
