@@ -1,0 +1,219 @@
+"""Sharded sorted-window FM step (parallel/sorted_sharded.py): equality
+vs the single-device sorted path on the 8-virtual-CPU-device mesh, and
+sharding-placement invariants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.models import get_model
+from xflow_tpu.ops.sorted_table import plan_sorted_batch, plan_sorted_stacked
+from xflow_tpu.optim import get_optimizer
+from xflow_tpu.parallel.mesh import make_mesh
+from xflow_tpu.parallel.sorted_sharded import (
+    make_sorted_sharded_train_step,
+    shard_sorted_state,
+    validate_sorted_sharded,
+)
+from xflow_tpu.train.state import TrainState, init_state
+from xflow_tpu.train.step import make_train_step
+
+
+def _cfg(d, t, **kw):
+    return override(
+        Config(),
+        **{
+            "model.name": "fm",
+            "data.log2_slots": 14,  # 16384 slots = 8 windows
+            "data.max_nnz": 8,
+            "data.batch_size": 64,
+            "mesh.data": d,
+            "mesh.table": t,
+            **kw,
+        },
+    )
+
+
+def _batch(cfg, rng, B):
+    S, F = cfg.num_slots, cfg.data.max_nnz
+    slots = rng.integers(0, S, (B, F)).astype(np.int32)
+    mask = (rng.random((B, F)) < 0.7).astype(np.float32)
+    labels = (rng.random(B) < 0.5).astype(np.float32)
+    return slots, mask, labels
+
+
+@pytest.mark.parametrize("d,t", [(2, 4), (4, 2), (8, 1), (1, 8)])
+def test_sharded_sorted_step_matches_single_device(d, t):
+    cfg = _cfg(d, t)
+    mesh = make_mesh(cfg, devices=jax.devices()[:8])
+    rng = np.random.default_rng(31)
+    B = cfg.data.batch_size
+    slots, mask, labels = _batch(cfg, rng, B)
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+
+    # single-device sorted reference
+    state0 = init_state(model, opt, cfg)
+    wv0 = np.asarray(state0.tables["wv"])
+    plan1 = plan_sorted_batch(slots, mask, cfg.num_slots)
+    ref_batch = {
+        "labels": jnp.asarray(labels),
+        "row_mask": jnp.ones((B,), jnp.float32),
+        "sorted_slots": jnp.asarray(plan1.sorted_slots),
+        "sorted_row": jnp.asarray(plan1.sorted_row),
+        "sorted_mask": jnp.asarray(plan1.sorted_mask),
+        "win_off": jnp.asarray(plan1.win_off),
+    }
+    step1 = make_train_step(model, opt, cfg)
+    s_ref, m_ref = step1(
+        TrainState({"wv": jnp.asarray(wv0)},
+                   opt.init_state({"wv": jnp.asarray(wv0)}),
+                   jnp.zeros((), jnp.int32)),
+        ref_batch,
+    )
+
+    # sharded sorted step: per-data-shard plans, table sharded over 'table'
+    plans = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=d)
+    ss = plans.sorted_slots if d > 1 else plans.sorted_slots[None]
+    sr = plans.sorted_row if d > 1 else plans.sorted_row[None]
+    sm = plans.sorted_mask if d > 1 else plans.sorted_mask[None]
+    wo = plans.win_off if d > 1 else plans.win_off[None]
+    batch = {
+        "labels": jnp.asarray(labels),
+        "row_mask": jnp.ones((B,), jnp.float32),
+        "sorted_slots": jnp.asarray(ss),
+        "sorted_row": jnp.asarray(sr),
+        "sorted_mask": jnp.asarray(sm),
+        "win_off": jnp.asarray(wo),
+    }
+    state = shard_sorted_state(
+        TrainState({"wv": jnp.asarray(wv0)},
+                   opt.init_state({"wv": jnp.asarray(wv0)}),
+                   jnp.zeros((), jnp.int32)),
+        mesh,
+    )
+    step = make_sorted_sharded_train_step(opt, cfg, mesh)
+    s_sh, m_sh = step(state, batch)
+
+    assert float(m_sh["loss"]) == pytest.approx(float(m_ref["loss"]), rel=1e-5)
+    assert float(m_sh["rows"]) == float(m_ref["rows"])
+    # table shards reassemble to the single-device result
+    np.testing.assert_allclose(
+        np.asarray(s_sh.tables["wv"]), np.asarray(s_ref.tables["wv"]),
+        rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_sh.opt_state["wv"]["n"]), np.asarray(s_ref.opt_state["wv"]["n"]),
+        rtol=1e-4, atol=1e-7,
+    )
+    # placement: the wv table is split on slot over 'table' only
+    shard_rows = {sh.data.shape[0] for sh in s_sh.tables["wv"].addressable_shards}
+    assert shard_rows == {cfg.num_slots // t}
+
+
+def test_sharded_sorted_multi_step_trajectory():
+    d, t = 2, 4
+    cfg = _cfg(d, t)
+    mesh = make_mesh(cfg, devices=jax.devices()[:8])
+    rng = np.random.default_rng(7)
+    B = cfg.data.batch_size
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+    state0 = init_state(model, opt, cfg)
+    wv0 = np.asarray(state0.tables["wv"])
+
+    step1 = make_train_step(model, opt, cfg)
+    s_ref = TrainState({"wv": jnp.asarray(wv0)},
+                       opt.init_state({"wv": jnp.asarray(wv0)}),
+                       jnp.zeros((), jnp.int32))
+    step_sh = make_sorted_sharded_train_step(opt, cfg, mesh)
+    s_sh = shard_sorted_state(
+        TrainState({"wv": jnp.asarray(wv0)},
+                   opt.init_state({"wv": jnp.asarray(wv0)}),
+                   jnp.zeros((), jnp.int32)),
+        mesh,
+    )
+    for i in range(3):
+        slots, mask, labels = _batch(cfg, rng, B)
+        p1 = plan_sorted_batch(slots, mask, cfg.num_slots)
+        s_ref, m_ref = step1(
+            s_ref,
+            {
+                "labels": jnp.asarray(labels),
+                "row_mask": jnp.ones((B,), jnp.float32),
+                "sorted_slots": jnp.asarray(p1.sorted_slots),
+                "sorted_row": jnp.asarray(p1.sorted_row),
+                "sorted_mask": jnp.asarray(p1.sorted_mask),
+                "win_off": jnp.asarray(p1.win_off),
+            },
+        )
+        pd = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=d)
+        s_sh, m_sh = step_sh(
+            s_sh,
+            {
+                "labels": jnp.asarray(labels),
+                "row_mask": jnp.ones((B,), jnp.float32),
+                "sorted_slots": jnp.asarray(pd.sorted_slots),
+                "sorted_row": jnp.asarray(pd.sorted_row),
+                "sorted_mask": jnp.asarray(pd.sorted_mask),
+                "win_off": jnp.asarray(pd.win_off),
+            },
+        )
+        assert float(m_sh["loss"]) == pytest.approx(float(m_ref["loss"]), rel=1e-5), i
+    np.testing.assert_allclose(
+        np.asarray(s_sh.tables["wv"]), np.asarray(s_ref.tables["wv"]),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_validate_sorted_sharded_rejects_bad_configs():
+    mesh = make_mesh(_cfg(2, 4), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="divisible by table_axis"):
+        validate_sorted_sharded(_cfg(2, 4, **{"data.log2_slots": 12}), mesh)
+    with pytest.raises(ValueError, match="fused FM only"):
+        validate_sorted_sharded(_cfg(2, 4, **{"model.name": "lr"}), mesh)
+    with pytest.raises(ValueError, match="not divisible by data axis"):
+        validate_sorted_sharded(_cfg(2, 4, **{"data.batch_size": 63}), mesh)
+
+
+def test_trainer_mesh_sorted_matches_gspmd(tmp_path):
+    """Trainer wiring: fused FM on a (2,4) mesh with sorted_layout on vs
+    off (GSPMD row-major) — identical final tables and AUC."""
+    from xflow_tpu.data.synth import generate_shards
+    from xflow_tpu.train.trainer import Trainer
+
+    generate_shards(str(tmp_path / "train"), 1, 400, num_fields=5, ids_per_field=60, seed=13)
+
+    def run(sorted_layout):
+        cfg = override(
+            Config(),
+            **{
+                "data.train_path": str(tmp_path / "train"),
+                "data.test_path": str(tmp_path / "train"),
+                "data.log2_slots": 14,
+                "data.batch_size": 64,
+                "data.max_nnz": 8,
+                "data.sorted_layout": sorted_layout,
+                "model.name": "fm",
+                "model.num_fields": 5,
+                "mesh.data": 2,
+                "mesh.table": 4,
+                "train.epochs": 2,
+                "train.pred_dump": False,
+            },
+        )
+        mesh = make_mesh(cfg, devices=jax.devices()[:8])
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr._sorted == (sorted_layout == "on")
+        assert tr._sorted_sharded == (sorted_layout == "on")
+        tr.fit()
+        return tr
+
+    t_on, t_off = run("on"), run("off")
+    np.testing.assert_allclose(
+        np.asarray(t_on.state.tables["wv"]), np.asarray(t_off.state.tables["wv"]),
+        rtol=1e-4, atol=1e-6,
+    )
+    auc_on, _ = t_on.evaluate()
+    auc_off, _ = t_off.evaluate()
+    assert auc_on == pytest.approx(auc_off, abs=1e-6)

@@ -57,21 +57,25 @@ def _second_order(vg, cfg):
     return second
 
 
-def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows, cfg):
-    from xflow_tpu.ops.sorted_table import _k8, row_sums_sorted, table_gather_sorted
+def stack_channels(occm_t, K):
+    """[K, Np] masked rows -> [ch, Np] (w, latents, squares, zero pad to a
+    sublane multiple) — the channel layout `fm_logits_from_sums` expects."""
+    from xflow_tpu.ops.sorted_table import _k8
 
-    K = wv.shape[1]
-    occ_t = table_gather_sorted(wv, sorted_slots, win_off)  # [K8, Np]
-    # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
-    occm_t = occ_t[:K] * sorted_mask[None, :]
     nch = 2 * K - 1  # w + k latents + k squares
     ch = _k8(nch)  # row_sums_sorted wants a sublane multiple
-    stacked = jnp.concatenate(
+    return jnp.concatenate(
         [occm_t, occm_t[1:] ** 2,
-         jnp.zeros((ch - nch, occ_t.shape[1]), occ_t.dtype)],
+         jnp.zeros((ch - nch, occm_t.shape[1]), occm_t.dtype)],
         axis=0,
-    )  # [ch, Np]
-    sums = row_sums_sorted(stacked, sorted_row, rows)  # [rows, ch]
+    )
+
+
+def fm_logits_from_sums(sums, K, cfg):
+    """[rows, ch] per-row channel sums -> [rows] logits. Shared by the
+    single-device sorted path and the sharded engine
+    (parallel/sorted_sharded.py) so the second-order math cannot drift."""
+    nch = 2 * K - 1
     wx = sums[:, 0]
     s, q = sums[:, 1:K], sums[:, K:nch]  # [rows, k] each
     if cfg.model.fm_standard:
@@ -82,6 +86,18 @@ def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows
         s_all, q_all = s.sum(axis=-1), q.sum(axis=-1)
         second = s_all * s_all - q_all
     return wx + second
+
+
+def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows, cfg):
+    from xflow_tpu.ops.sorted_table import row_sums_sorted, table_gather_sorted
+
+    K = wv.shape[1]
+    occ_t = table_gather_sorted(wv, sorted_slots, win_off)  # [K8, Np]
+    # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
+    occm_t = occ_t[:K] * sorted_mask[None, :]
+    stacked = stack_channels(occm_t, K)  # [ch, Np]
+    sums = row_sums_sorted(stacked, sorted_row, rows)  # [rows, ch]
+    return fm_logits_from_sums(sums, K, cfg)
 
 
 def _forward_sorted(tables, batch, cfg):

@@ -54,7 +54,11 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh) -> dict:
-    """Batch arrays split on the leading (row) axis over the data axis."""
+    """Batch arrays split on the leading (row) axis over the data axis.
+
+    The sorted-plan entries ([D, Np_l] stacked per-data-shard plans,
+    parallel/sorted_sharded.py) shard their leading axis the same way.
+    """
     row2d = NamedSharding(mesh, P(DATA_AXIS, None))
     row1d = NamedSharding(mesh, P(DATA_AXIS))
     return {
@@ -63,6 +67,11 @@ def batch_sharding(mesh: Mesh) -> dict:
         "mask": row2d,
         "labels": row1d,
         "row_mask": row1d,
+        "sorted_slots": row2d,
+        "sorted_row": row2d,
+        "sorted_mask": row2d,
+        "sorted_fields": row2d,
+        "win_off": row2d,
     }
 
 

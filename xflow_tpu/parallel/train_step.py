@@ -53,16 +53,19 @@ def make_sharded_train_step(
         ssh = state_shardings(state, mesh)
         return jax.jit(
             sharded,
-            in_shardings=(ssh, bsh),
+            # subset to the batch's actual keys: jit in_shardings must
+            # match the pytree exactly, and batch_sharding carries entries
+            # for optional arrays (sorted plans) too
+            in_shardings=(ssh, {k: bsh[k] for k in batch}),
             out_shardings=(ssh, out_metrics_sh),
             donate_argnums=(0,),
         )
 
-    # cache the jitted fn once the state structure is known
+    # cache the jitted fn per batch-key set (state structure is fixed)
     cache = {}
 
     def call(state: TrainState, batch: dict):
-        key = "step"
+        key = frozenset(batch)
         if key not in cache:
             cache[key] = wrap(state, batch)
         return cache[key](state, batch)
@@ -76,13 +79,22 @@ def make_sharded_eval_step(model: Model, cfg: Config, mesh: Mesh) -> Callable:
     cache = {}
 
     def call(tables, batch):
-        if "ev" not in cache:
-            tsh = state_shardings(tables, mesh)
-            cache["ev"] = jax.jit(
+        key = frozenset(batch)
+        if key not in cache:
+            # accept the tables AS SHARDED (jit with explicit in_shardings
+            # rejects mismatches instead of resharding): the GSPMD eval
+            # forward partitions fine under either the default
+            # P(('data','table')) layout or the sorted engine's
+            # P('table', None)
+            tsh = jax.tree.map(
+                lambda x: x.sharding if hasattr(x, "sharding") else replicated(mesh),
+                tables,
+            )
+            cache[key] = jax.jit(
                 ev,
-                in_shardings=(tsh, bsh),
+                in_shardings=(tsh, {k: bsh[k] for k in batch}),
                 out_shardings=NamedSharding(mesh, P("data")),
             )
-        return cache["ev"](tables, batch)
+        return cache[key](tables, batch)
 
     return call

@@ -72,13 +72,81 @@ class Trainer:
         self.optimizer = get_optimizer(cfg.optim.name)
         self.mesh = mesh
         self.rank = process_index
+        # sorted-window table layout (ops/sorted_table.py):
+        # - single device: fused-FM and MVM (Pallas kernels / XLA fallback)
+        # - mesh: fused-FM via the sharded engine (parallel/sorted_sharded
+        #   .py — table sharded over the 'table' axis, per-data-shard
+        #   plans, one row-sum psum); single-process only in v1 (the data
+        #   axis would need per-process sub-plan assembly). Other
+        #   mesh configs keep the GSPMD row-major path.
+        from xflow_tpu.ops.sorted_table import WINDOW, resolve_sub_batches
+
+        sl = cfg.data.sorted_layout
+        if mesh is not None:
+            # mesh: the sharded engine replicates the table across the
+            # 'data' axis (D× memory — parallel/sorted_sharded.py
+            # docstring), so it is OPT-IN only: 'auto' keeps the fully-
+            # sharded GSPMD path that the 1B-feature regime needs
+            self._sorted = sl == "on"
+            if self._sorted:
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "sorted_layout=on on a mesh is single-process only "
+                        "(per-process sub-plan assembly is not implemented); "
+                        "use sorted_layout=auto for the GSPMD path"
+                    )
+                from xflow_tpu.parallel.sorted_sharded import validate_sorted_sharded
+
+                validate_sorted_sharded(cfg, mesh)  # specific diagnostics
+        else:
+            supported = (
+                cfg.model.name == "fm" and cfg.model.fm_fused
+            ) or cfg.model.name == "mvm"
+            self._sorted = sl == "on" or (
+                sl == "auto" and supported and cfg.num_slots % WINDOW == 0
+            )
+            if sl == "on":
+                # 'on' forces the layout, so reject configurations where it
+                # cannot work instead of failing deep inside sharding/XLA
+                # (or silently paying the host sort for an unused layout)
+                if not supported:
+                    raise ValueError(
+                        "sorted_layout=on requires model.name=fm with "
+                        "model.fm_fused=true, or model.name=mvm; got "
+                        f"model={cfg.model.name} fm_fused={cfg.model.fm_fused}"
+                    )
+                if cfg.num_slots % WINDOW != 0:
+                    raise ValueError(
+                        f"sorted_layout=on needs num_slots divisible by {WINDOW}; "
+                        f"got 2^{cfg.data.log2_slots}"
+                    )
+        self._sorted_sharded = self._sorted and mesh is not None
+        if self._sorted_sharded:
+            self._sorted_sub = mesh.shape["data"]  # one plan per data shard
+        else:
+            self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
         if mesh is not None:
             from xflow_tpu.parallel.train_step import make_sharded_train_step, make_sharded_eval_step, shard_state
 
-            self.state = shard_state(
-                init_state(self.model, self.optimizer, cfg), mesh
-            )
-            self.train_step = make_sharded_train_step(self.model, self.optimizer, cfg, mesh)
+            if self._sorted_sharded:
+                from xflow_tpu.parallel.sorted_sharded import (
+                    make_sorted_sharded_train_step,
+                    shard_sorted_state,
+                )
+
+                self.state = shard_sorted_state(
+                    init_state(self.model, self.optimizer, cfg), mesh
+                )
+                self.train_step = make_sorted_sharded_train_step(
+                    self.optimizer, cfg, mesh
+                )
+            else:
+                self.state = shard_state(
+                    init_state(self.model, self.optimizer, cfg), mesh
+                )
+                self.train_step = make_sharded_train_step(self.model, self.optimizer, cfg, mesh)
+            # eval keeps the GSPMD row-major path either way (forward-only;
+            # jit reshards the table-axis state on entry)
             self.eval_step = make_sharded_eval_step(self.model, cfg, mesh)
             self._shard_batch = lambda b: _shard_batch_arrays(b, mesh)
         else:
@@ -87,38 +155,6 @@ class Trainer:
             self.eval_step = make_eval_step(self.model, cfg)
             self._shard_batch = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
         self.metrics = MetricsLogger(cfg.train.metrics_path)
-        # sorted-window table layout (ops/sorted_table.py): single-device
-        # fused-FM and MVM — the mesh path keeps XLA gather/scatter
-        # (GSPMD owns cross-chip layout there)
-        from xflow_tpu.ops.sorted_table import WINDOW
-
-        sl = cfg.data.sorted_layout
-        supported = mesh is None and (
-            (cfg.model.name == "fm" and cfg.model.fm_fused) or cfg.model.name == "mvm"
-        )
-        self._sorted = sl == "on" or (
-            sl == "auto" and supported and cfg.num_slots % WINDOW == 0
-        )
-        if sl == "on":
-            # 'on' forces the layout, so reject configurations where it
-            # cannot work instead of failing deep inside sharding/XLA
-            # (or silently paying the host sort for an unused layout)
-            if not supported:
-                raise ValueError(
-                    "sorted_layout=on requires model.name=fm with "
-                    "model.fm_fused=true, or model.name=mvm, on a single "
-                    f"device (mesh=None); got model={cfg.model.name} "
-                    f"fm_fused={cfg.model.fm_fused} "
-                    f"mesh={'set' if mesh is not None else 'None'}"
-                )
-            if cfg.num_slots % WINDOW != 0:
-                raise ValueError(
-                    f"sorted_layout=on needs num_slots divisible by {WINDOW}; "
-                    f"got 2^{cfg.data.log2_slots}"
-                )
-        from xflow_tpu.ops.sorted_table import resolve_sub_batches
-
-        self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
         # MVM keys its views on the field id: a field >= num_fields would be
         # silently dropped by the one-hot, so reject it loudly
         self._validate_fields = cfg.model.name == "mvm"
@@ -132,10 +168,18 @@ class Trainer:
                     f"{self.cfg.model.num_fields}; raise model.num_fields"
                 )
 
-    def _batch_arrays(self, batch) -> dict:
-        """SparseBatch -> step input arrays (+ sorted-layout plan)."""
+    def _batch_arrays(self, batch, with_plan: bool = True) -> dict:
+        """SparseBatch -> step input arrays (+ sorted-layout plan).
+
+        On the sharded sorted path the step consumes ONLY the plan +
+        labels/row_mask, so the row-major [B, F] arrays are dropped
+        (they would be dead ~14 MB host→device transfers per step);
+        eval batches are built separately with `with_plan=False`.
+        """
         arrays = batch_to_arrays(batch)
-        if self._sorted:
+        if self._sorted_sharded and with_plan:
+            arrays = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
+        if self._sorted and with_plan:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
             mvm = self.cfg.model.name == "mvm"
@@ -146,14 +190,18 @@ class Trainer:
                 fields=np.asarray(batch.fields) if mvm else None,
                 num_sub=self._sorted_sub,
             )
+            stack = (
+                (lambda a: a[None]) if self._sorted_sharded and plan.sorted_slots.ndim == 1
+                else (lambda a: a)
+            )  # the sharded engine wants a leading [D] axis even at D=1
             arrays.update(
-                sorted_slots=plan.sorted_slots,
-                sorted_row=plan.sorted_row,
-                sorted_mask=plan.sorted_mask,
-                win_off=plan.win_off,
+                sorted_slots=stack(plan.sorted_slots),
+                sorted_row=stack(plan.sorted_row),
+                sorted_mask=stack(plan.sorted_mask),
+                win_off=stack(plan.win_off),
             )
             if mvm:
-                arrays["sorted_fields"] = plan.sorted_fields
+                arrays["sorted_fields"] = stack(plan.sorted_fields)
         return arrays
 
     # -------------------------------------------------------- multi-process IO
@@ -193,28 +241,30 @@ class Trainer:
         counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
         return int(counts.max()), local
 
-    def _with_arrays(self, batch):
+    def _with_arrays(self, batch, with_plan: bool = True):
         """(batch, step-input arrays) — validation + sorted-plan building
         happen HERE so that, wrapped in `prefetch`, the host-side sort
         overlaps device compute instead of serializing with dispatch."""
         self._check_batch(batch)
-        return batch, self._batch_arrays(batch)
+        return batch, self._batch_arrays(batch, with_plan=with_plan)
 
-    def _coordinated_batches(self, path: str):
+    def _coordinated_batches(self, path: str, with_plan: bool = True):
         """Yield exactly the globally-agreed number of (batch, arrays)
         pairs for `path`, padding with fully-masked empty batches once
         local input is exhausted. Collective-free on the host side after
-        the one counting allgather (cached across epochs)."""
+        the one counting allgather (cached across epochs). `with_plan`
+        false skips sorted-plan building (mesh eval runs row-major)."""
+        prepare = lambda b: self._with_arrays(b, with_plan=with_plan)
         if jax.process_count() == 1:
             yield from prefetch(
-                map(self._with_arrays, batch_iterator(path, self.cfg.data))
+                map(prepare, batch_iterator(path, self.cfg.data))
             )
             return
         global_steps, local = self._global_batch_count(path)
         # open the real iterator whenever the file exists (even if counted
         # 0) so the drift check below can catch a counter that under-reads
         it = (
-            iter(prefetch(map(self._with_arrays, batch_iterator(path, self.cfg.data))))
+            iter(prefetch(map(prepare, batch_iterator(path, self.cfg.data))))
             if os.path.exists(path)
             else iter(())
         )
@@ -222,7 +272,7 @@ class Trainer:
         for _ in range(global_steps):
             pair = next(it, None)
             if pair is None:
-                pair = self._with_arrays(self._empty_batch())
+                pair = prepare(self._empty_batch())
             else:
                 produced += 1
             yield pair
@@ -402,7 +452,9 @@ class Trainer:
         dump = dump and (not multiproc or self.rank == 0)
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
-        for batch, arrays in self._coordinated_batches(path):
+        for batch, arrays in self._coordinated_batches(
+            path, with_plan=not self._sorted_sharded
+        ):
             arrays = self._shard_batch(arrays)
             p_dev = self.eval_step(self.state.tables, arrays)
             if multiproc:
@@ -453,7 +505,9 @@ class Trainer:
         neg = np.zeros(num_buckets, np.float64)
         ll_sum, n_rows = 0.0, 0.0
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
-        for batch, arrays in self._coordinated_batches(path):
+        for batch, arrays in self._coordinated_batches(
+            path, with_plan=not self._sorted_sharded
+        ):
             arrays = self._shard_batch(arrays)
             p = self._local_pctrs(self.eval_step(self.state.tables, arrays))
             rm = np.asarray(batch.row_mask) > 0
