@@ -74,18 +74,14 @@ def test_sharded_sorted_step_matches_single_device(d, t):
     )
 
     # sharded sorted step: per-data-shard plans, table sharded over 'table'
-    plans = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=d)
-    ss = plans.sorted_slots if d > 1 else plans.sorted_slots[None]
-    sr = plans.sorted_row if d > 1 else plans.sorted_row[None]
-    sm = plans.sorted_mask if d > 1 else plans.sorted_mask[None]
-    wo = plans.win_off if d > 1 else plans.win_off[None]
+    plans = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=d, always_stack=True)
     batch = {
         "labels": jnp.asarray(labels),
         "row_mask": jnp.ones((B,), jnp.float32),
-        "sorted_slots": jnp.asarray(ss),
-        "sorted_row": jnp.asarray(sr),
-        "sorted_mask": jnp.asarray(sm),
-        "win_off": jnp.asarray(wo),
+        "sorted_slots": jnp.asarray(plans.sorted_slots),
+        "sorted_row": jnp.asarray(plans.sorted_row),
+        "sorted_mask": jnp.asarray(plans.sorted_mask),
+        "win_off": jnp.asarray(plans.win_off),
     }
     state = shard_sorted_state(
         TrainState({"wv": jnp.asarray(wv0)},

@@ -68,6 +68,15 @@ def validate_sorted_sharded(cfg: Config, mesh: Mesh) -> None:
         )
     if not (cfg.model.name == "fm" and cfg.model.fm_fused):
         raise ValueError("sorted sharded layout supports fused FM only")
+    if cfg.data.sorted_sub_batches not in (0, d):
+        # the plan count IS the data-axis size here; silently overriding a
+        # user's explicit single-device tuning value would benchmark a
+        # different configuration than they asked for
+        raise ValueError(
+            f"data.sorted_sub_batches={cfg.data.sorted_sub_batches} conflicts "
+            f"with the mesh sorted path (plan count = data axis = {d}); "
+            "leave it 0"
+        )
 
 
 def sorted_batch_sharding(mesh: Mesh) -> dict:

@@ -146,14 +146,18 @@ class Trainer:
                 )
                 self.train_step = make_sharded_train_step(self.model, self.optimizer, cfg, mesh)
             # eval keeps the GSPMD row-major path either way (forward-only;
-            # jit reshards the table-axis state on entry)
+            # make_sharded_eval_step adopts the tables' LIVE sharding as its
+            # in_sharding — jit never reshards explicit in_shardings)
             self.eval_step = make_sharded_eval_step(self.model, cfg, mesh)
             self._shard_batch = lambda b: _shard_batch_arrays(b, mesh)
         else:
             self.state = init_state(self.model, self.optimizer, cfg)
             self.train_step = make_train_step(self.model, self.optimizer, cfg)
             self.eval_step = make_eval_step(self.model, cfg)
-            self._shard_batch = lambda b: {k: jnp.asarray(v) for k, v in b.items()}
+            # ONE async device_put for the whole dict: per-array jnp.asarray
+            # is a synchronous round trip each, which dominates on
+            # high-latency links (tunneled devices: ~9 arrays × RTT/step)
+            self._shard_batch = jax.device_put
         self.metrics = MetricsLogger(cfg.train.metrics_path)
         # MVM keys its views on the field id: a field >= num_fields would be
         # silently dropped by the one-hot, so reject it loudly
@@ -171,17 +175,18 @@ class Trainer:
     def _batch_arrays(self, batch, with_plan: bool = True) -> dict:
         """SparseBatch -> step input arrays (+ sorted-layout plan).
 
-        On the sharded sorted path the step consumes ONLY the plan +
-        labels/row_mask, so the row-major [B, F] arrays are dropped
-        (they would be dead ~14 MB host→device transfers per step);
-        eval batches are built separately with `with_plan=False`.
+        On the sorted paths the step consumes ONLY the plan +
+        labels/row_mask (+ sorted_fields for MVM), so the row-major
+        [B, F] arrays are dropped — they would be dead ~24 MB
+        host→device transfers per 64k-row batch. (Single-device eval
+        also runs the sorted forward, so this holds for eval batches
+        too; mesh eval passes `with_plan=False` and keeps row-major.)
         """
         arrays = batch_to_arrays(batch)
-        if self._sorted_sharded and with_plan:
-            arrays = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
         if self._sorted and with_plan:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
+            arrays = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
             mvm = self.cfg.model.name == "mvm"
             plan = plan_sorted_stacked(
                 np.asarray(batch.slots),
@@ -189,19 +194,17 @@ class Trainer:
                 self.cfg.num_slots,
                 fields=np.asarray(batch.fields) if mvm else None,
                 num_sub=self._sorted_sub,
+                # the sharded engine wants a leading [D] axis even at D=1
+                always_stack=self._sorted_sharded,
             )
-            stack = (
-                (lambda a: a[None]) if self._sorted_sharded and plan.sorted_slots.ndim == 1
-                else (lambda a: a)
-            )  # the sharded engine wants a leading [D] axis even at D=1
             arrays.update(
-                sorted_slots=stack(plan.sorted_slots),
-                sorted_row=stack(plan.sorted_row),
-                sorted_mask=stack(plan.sorted_mask),
-                win_off=stack(plan.win_off),
+                sorted_slots=plan.sorted_slots,
+                sorted_row=plan.sorted_row,
+                sorted_mask=plan.sorted_mask,
+                win_off=plan.win_off,
             )
             if mvm:
-                arrays["sorted_fields"] = stack(plan.sorted_fields)
+                arrays["sorted_fields"] = plan.sorted_fields
         return arrays
 
     # -------------------------------------------------------- multi-process IO
