@@ -44,6 +44,19 @@ def _batch(cfg, rng, B):
     return slots, mask, labels
 
 
+
+def _plan_batch(plan, labels, B):
+    """Step-input dict from a SortedPlan (flat or stacked)."""
+    return {
+        "labels": jnp.asarray(labels),
+        "row_mask": jnp.ones((B,), jnp.float32),
+        "sorted_slots": jnp.asarray(plan.sorted_slots),
+        "sorted_row": jnp.asarray(plan.sorted_row),
+        "sorted_mask": jnp.asarray(plan.sorted_mask),
+        "win_off": jnp.asarray(plan.win_off),
+    }
+
+
 @pytest.mark.parametrize("d,t", [(2, 4), (4, 2), (8, 1), (1, 8)])
 def test_sharded_sorted_step_matches_single_device(d, t):
     cfg = _cfg(d, t)
@@ -57,14 +70,7 @@ def test_sharded_sorted_step_matches_single_device(d, t):
     state0 = init_state(model, opt, cfg)
     wv0 = np.asarray(state0.tables["wv"])
     plan1 = plan_sorted_batch(slots, mask, cfg.num_slots)
-    ref_batch = {
-        "labels": jnp.asarray(labels),
-        "row_mask": jnp.ones((B,), jnp.float32),
-        "sorted_slots": jnp.asarray(plan1.sorted_slots),
-        "sorted_row": jnp.asarray(plan1.sorted_row),
-        "sorted_mask": jnp.asarray(plan1.sorted_mask),
-        "win_off": jnp.asarray(plan1.win_off),
-    }
+    ref_batch = _plan_batch(plan1, labels, B)
     step1 = make_train_step(model, opt, cfg)
     s_ref, m_ref = step1(
         TrainState({"wv": jnp.asarray(wv0)},
@@ -75,14 +81,7 @@ def test_sharded_sorted_step_matches_single_device(d, t):
 
     # sharded sorted step: per-data-shard plans, table sharded over 'table'
     plans = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=d, always_stack=True)
-    batch = {
-        "labels": jnp.asarray(labels),
-        "row_mask": jnp.ones((B,), jnp.float32),
-        "sorted_slots": jnp.asarray(plans.sorted_slots),
-        "sorted_row": jnp.asarray(plans.sorted_row),
-        "sorted_mask": jnp.asarray(plans.sorted_mask),
-        "win_off": jnp.asarray(plans.win_off),
-    }
+    batch = _plan_batch(plans, labels, B)
     state = shard_sorted_state(
         TrainState({"wv": jnp.asarray(wv0)},
                    opt.init_state({"wv": jnp.asarray(wv0)}),
@@ -134,26 +133,12 @@ def test_sharded_sorted_multi_step_trajectory():
         p1 = plan_sorted_batch(slots, mask, cfg.num_slots)
         s_ref, m_ref = step1(
             s_ref,
-            {
-                "labels": jnp.asarray(labels),
-                "row_mask": jnp.ones((B,), jnp.float32),
-                "sorted_slots": jnp.asarray(p1.sorted_slots),
-                "sorted_row": jnp.asarray(p1.sorted_row),
-                "sorted_mask": jnp.asarray(p1.sorted_mask),
-                "win_off": jnp.asarray(p1.win_off),
-            },
+            _plan_batch(p1, labels, B),
         )
         pd = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=d)
         s_sh, m_sh = step_sh(
             s_sh,
-            {
-                "labels": jnp.asarray(labels),
-                "row_mask": jnp.ones((B,), jnp.float32),
-                "sorted_slots": jnp.asarray(pd.sorted_slots),
-                "sorted_row": jnp.asarray(pd.sorted_row),
-                "sorted_mask": jnp.asarray(pd.sorted_mask),
-                "win_off": jnp.asarray(pd.win_off),
-            },
+            _plan_batch(pd, labels, B),
         )
         assert float(m_sh["loss"]) == pytest.approx(float(m_ref["loss"]), rel=1e-5), i
     np.testing.assert_allclose(
@@ -213,3 +198,30 @@ def test_trainer_mesh_sorted_matches_gspmd(tmp_path):
     auc_on, _ = t_on.evaluate()
     auc_off, _ = t_off.evaluate()
     assert auc_on == pytest.approx(auc_off, abs=1e-6)
+
+
+def test_sorted_sharded_checkpoint_roundtrip(tmp_path):
+    """The table-axis-only sharded state (P('table', None)) survives an
+    npz save/restore with sharding and values intact."""
+    from xflow_tpu.train import checkpoint as ckpt
+
+    cfg = _cfg(2, 4)
+    mesh = make_mesh(cfg, devices=jax.devices()[:8])
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+    state = shard_sorted_state(init_state(model, opt, cfg), mesh)
+    rng = np.random.default_rng(3)
+    slots, mask, labels = _batch(cfg, rng, cfg.data.batch_size)
+    plans = plan_sorted_stacked(slots, mask, cfg.num_slots, num_sub=2, always_stack=True)
+    step = make_sorted_sharded_train_step(opt, cfg, mesh)
+    state, _ = step(state, _plan_batch(plans, labels, cfg.data.batch_size))
+    ckpt.save(str(tmp_path), state)
+    like = shard_sorted_state(init_state(model, opt, cfg), mesh)
+    restored = ckpt.restore(str(tmp_path), like)
+    np.testing.assert_array_equal(
+        np.asarray(restored.tables["wv"]), np.asarray(state.tables["wv"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.opt_state["wv"]["z"]), np.asarray(state.opt_state["wv"]["z"])
+    )
+    assert restored.tables["wv"].sharding == state.tables["wv"].sharding
+    assert int(restored.step) == int(state.step) == 1
