@@ -37,6 +37,7 @@ Two implementations with identical semantics:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -76,6 +77,16 @@ def padded_len(n: int) -> int:
 
 
 _NATIVE_PLAN = None  # tri-state: None = untried, False = unavailable, else fn
+_PLAN_POOL = None  # cached executor: one per process, not one per batch
+
+
+def _plan_pool(workers: int):
+    global _PLAN_POOL
+    if _PLAN_POOL is None or _PLAN_POOL._max_workers < workers:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _PLAN_POOL = ThreadPoolExecutor(max_workers=workers)
+    return _PLAN_POOL
 
 
 def _native_planner():
@@ -86,8 +97,6 @@ def _native_planner():
     forces the numpy path (used by the parity tests)."""
     global _NATIVE_PLAN
     if _NATIVE_PLAN is None:
-        import os
-
         if os.environ.get("XFLOW_NO_NATIVE_PLAN"):
             _NATIVE_PLAN = False
         else:
@@ -185,15 +194,24 @@ def plan_sorted_stacked(
     if B % num_sub:
         raise ValueError(f"batch {B} not divisible by num_sub {num_sub}")
     bs = B // num_sub
-    plans = [
-        plan_sorted_batch(
+
+    def one(i):
+        return plan_sorted_batch(
             slots[i * bs : (i + 1) * bs],
             mask[i * bs : (i + 1) * bs],
             num_slots,
             fields=None if fields is None else fields[i * bs : (i + 1) * bs],
         )
-        for i in range(num_sub)
-    ]
+
+    workers = min(num_sub, os.cpu_count() or 1)
+    if workers > 1 and _native_planner():
+        # the C planner (xf_plan_sorted) releases the GIL during the sort,
+        # so sub-batch plans parallelize across host cores; the numpy
+        # fallback holds the GIL through argsort, where threads would only
+        # add churn. ex.map preserves sub-batch order.
+        plans = list(_plan_pool(workers).map(one, range(num_sub)))
+    else:
+        plans = [one(i) for i in range(num_sub)]
     return SortedPlan(
         sorted_slots=np.stack([p.sorted_slots for p in plans]),
         sorted_row=np.stack([p.sorted_row for p in plans]),
