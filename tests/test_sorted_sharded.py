@@ -153,8 +153,10 @@ def test_validate_sorted_sharded_rejects_bad_configs():
         validate_sorted_sharded(_cfg(2, 4, **{"data.log2_slots": 12}), mesh)
     with pytest.raises(ValueError, match="fused FM only"):
         validate_sorted_sharded(_cfg(2, 4, **{"model.name": "lr"}), mesh)
-    with pytest.raises(ValueError, match="not divisible by data axis"):
+    with pytest.raises(ValueError, match="not divisible by"):
         validate_sorted_sharded(_cfg(2, 4, **{"data.batch_size": 63}), mesh)
+    with pytest.raises(ValueError, match="conflicts with the mesh sorted path"):
+        validate_sorted_sharded(_cfg(2, 4, **{"data.sorted_sub_batches": 8}), mesh)
 
 
 def test_trainer_mesh_sorted_matches_gspmd(tmp_path):

@@ -78,14 +78,18 @@ def padded_len(n: int) -> int:
 
 _NATIVE_PLAN = None  # tri-state: None = untried, False = unavailable, else fn
 _PLAN_POOL = None  # cached executor: one per process, not one per batch
+_PLAN_POOL_WORKERS = 0
 
 
 def _plan_pool(workers: int):
-    global _PLAN_POOL
-    if _PLAN_POOL is None or _PLAN_POOL._max_workers < workers:
+    global _PLAN_POOL, _PLAN_POOL_WORKERS
+    if _PLAN_POOL is None or _PLAN_POOL_WORKERS < workers:
         from concurrent.futures import ThreadPoolExecutor
 
+        if _PLAN_POOL is not None:
+            _PLAN_POOL.shutdown(wait=False)
         _PLAN_POOL = ThreadPoolExecutor(max_workers=workers)
+        _PLAN_POOL_WORKERS = workers
     return _PLAN_POOL
 
 
@@ -204,7 +208,7 @@ def plan_sorted_stacked(
         )
 
     workers = min(num_sub, os.cpu_count() or 1)
-    if workers > 1 and _native_planner():
+    if workers > 1 and _native_planner() and num_slots % WINDOW == 0:
         # the C planner (xf_plan_sorted) releases the GIL during the sort,
         # so sub-batch plans parallelize across host cores; the numpy
         # fallback holds the GIL through argsort, where threads would only

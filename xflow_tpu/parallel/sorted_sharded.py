@@ -55,26 +55,39 @@ from xflow_tpu.train.state import TrainState
 
 
 def validate_sorted_sharded(cfg: Config, mesh: Mesh) -> None:
+    """Reject configs the sharded sorted engine cannot run, with the
+    specific reason. Multi-process: each of P processes plans its OWN
+    (per-process) batch into d/P sub-plans, so the divisibility
+    requirements are per-process."""
     d, t = mesh.shape[DATA_AXIS], mesh.shape[TABLE_AXIS]
+    p = jax.process_count()
     S = cfg.num_slots
     if S % (t * WINDOW) != 0:
         raise ValueError(
             f"sorted sharded layout needs num_slots (2^{cfg.data.log2_slots}) "
             f"divisible by table_axis*WINDOW = {t}*{WINDOW}"
         )
-    if cfg.data.batch_size % d != 0:
+    if d % p != 0:
         raise ValueError(
-            f"batch_size {cfg.data.batch_size} not divisible by data axis {d}"
+            f"sorted sharded layout needs the data axis ({d}) divisible by "
+            f"the process count ({p}): each process plans its rows into d/P "
+            "sub-plans"
+        )
+    if cfg.data.batch_size % (d // p) != 0:
+        raise ValueError(
+            f"per-process batch_size {cfg.data.batch_size} not divisible by "
+            f"the local data-shard count {d // p} (data axis {d} / {p} "
+            "process(es))"
         )
     if not (cfg.model.name == "fm" and cfg.model.fm_fused):
         raise ValueError("sorted sharded layout supports fused FM only")
-    if cfg.data.sorted_sub_batches not in (0, d):
-        # the plan count IS the data-axis size here; silently overriding a
+    if cfg.data.sorted_sub_batches not in (0, d // p):
+        # the per-process plan count IS d/P here; silently overriding a
         # user's explicit single-device tuning value would benchmark a
         # different configuration than they asked for
         raise ValueError(
             f"data.sorted_sub_batches={cfg.data.sorted_sub_batches} conflicts "
-            f"with the mesh sorted path (plan count = data axis = {d}); "
+            f"with the mesh sorted path (per-process plan count = {d // p}); "
             "leave it 0"
         )
 

@@ -76,8 +76,9 @@ class Trainer:
         # - single device: fused-FM and MVM (Pallas kernels / XLA fallback)
         # - mesh: fused-FM via the sharded engine (parallel/sorted_sharded
         #   .py — table sharded over the 'table' axis, per-data-shard
-        #   plans, one row-sum psum); single-process only in v1 (the data
-        #   axis would need per-process sub-plan assembly). Other
+        #   plans, one row-sum psum). Multi-process works when the data
+        #   axis divides across processes: each process plans its own
+        #   rows into D/P sub-plans (2-process subprocess-tested). Other
         #   mesh configs keep the GSPMD row-major path.
         from xflow_tpu.ops.sorted_table import WINDOW, resolve_sub_batches
 
@@ -89,12 +90,6 @@ class Trainer:
             # sharded GSPMD path that the 1B-feature regime needs
             self._sorted = sl == "on"
             if self._sorted:
-                if jax.process_count() > 1:
-                    raise ValueError(
-                        "sorted_layout=on on a mesh is single-process only "
-                        "(per-process sub-plan assembly is not implemented); "
-                        "use sorted_layout=auto for the GSPMD path"
-                    )
                 from xflow_tpu.parallel.sorted_sharded import validate_sorted_sharded
 
                 validate_sorted_sharded(cfg, mesh)  # specific diagnostics
@@ -122,7 +117,8 @@ class Trainer:
                     )
         self._sorted_sharded = self._sorted and mesh is not None
         if self._sorted_sharded:
-            self._sorted_sub = mesh.shape["data"]  # one plan per data shard
+            # one plan per LOCAL data shard; other processes build theirs
+            self._sorted_sub = mesh.shape["data"] // jax.process_count()
         else:
             self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
         if mesh is not None:
