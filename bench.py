@@ -61,6 +61,7 @@ def bench_e2e(args) -> int:
                 # carrying 14 dead masked columns per row through the host
                 # sort, the transfer, and the kernels
                 "data.max_nnz": 18,
+                "data.sorted_bf16": args.sorted_bf16,
                 "data.batch_size": args.batch if not args.smoke else 2048,
                 "data.sorted_sub_batches": args.sub_batches,
                 "model.num_fields": 18,
@@ -107,6 +108,8 @@ def main() -> int:
                     help="sorted-layout sub-batches per step (0 = auto)")
     ap.add_argument("--no-zipf", action="store_true",
                     help="skip the skewed-slot (Zipf) companion runs")
+    ap.add_argument("--sorted-bf16", action="store_true",
+                    help="bf16 fast mode for the sorted kernels (cfg.data.sorted_bf16)")
     ap.add_argument("--e2e", action="store_true",
                     help="end-to-end pipeline bench (file -> C++ parser -> "
                          "sorted plan -> device) instead of pre-staged batches")
@@ -163,6 +166,7 @@ def main() -> int:
                 "data.max_nnz": args.nnz,
                 "data.batch_size": args.batch,
                 "data.sorted_sub_batches": args.sub_batches,
+                "data.sorted_bf16": args.sorted_bf16,
             },
         )
         model, opt = get_model(name), get_optimizer("ftrl")

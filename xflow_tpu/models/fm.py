@@ -92,7 +92,9 @@ def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows
     from xflow_tpu.ops.sorted_table import row_sums_sorted, table_gather_sorted
 
     K = wv.shape[1]
-    occ_t = table_gather_sorted(wv, sorted_slots, win_off)  # [K8, Np]
+    occ_t = table_gather_sorted(
+        wv, sorted_slots, win_off, cfg.data.sorted_bf16
+    )  # [K8, Np]
     # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
     occm_t = occ_t[:K] * sorted_mask[None, :]
     stacked = stack_channels(occm_t, K)  # [ch, Np]

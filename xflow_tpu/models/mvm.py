@@ -37,14 +37,14 @@ def _table_specs(cfg):
 
 
 def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
-                        win_off, rows, nf):
+                        win_off, rows, nf, bf16=False):
     """One sub-batch: [K8, Np] windowed gather + one segment-sum keyed on
     `row * nf + field` → logits [rows]."""
     from xflow_tpu.ops.sorted_table import table_gather_sorted
 
     k = v.shape[1]
     seg = sorted_row * nf + sorted_fields  # [Np]
-    occ_t = table_gather_sorted(v, sorted_slots, win_off)  # [K8, Np]
+    occ_t = table_gather_sorted(v, sorted_slots, win_off, bf16)  # [K8, Np]
     occm_t = occ_t[:k] * sorted_mask[None, :]
     # stack the mask as one extra channel: its segment-sum is the
     # per-(row, field) occurrence count, giving `present` in the same op
@@ -76,8 +76,11 @@ def _forward_sorted(tables, batch, cfg):
 
     v = tables["v"]
     nf = cfg.model.num_fields
+    bf16 = cfg.data.sorted_bf16
     return map_sub_batches(
-        lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(v, ss, sr, sm, sf, wo, rows, nf),
+        lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(
+            v, ss, sr, sm, sf, wo, rows, nf, bf16
+        ),
         batch,
         ("sorted_slots", "sorted_row", "sorted_mask", "sorted_fields", "win_off"),
         batch["labels"].shape[0],

@@ -302,7 +302,43 @@ def _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k: int):
 
 # --------------------------------------------------------------- Pallas path
 
-def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, acc, old, sem_s, sem_d):
+def _dot_f32(a, onehot_f32, dims, bf16: bool):
+    """MXU contraction of `a` against a 0/1 matrix, f32-accurate by default.
+
+    `bf16=False` (default): splits `a` into three bf16 terms (hi/mid/lo,
+    8 mantissa bits each — together the full f32 mantissa) and runs
+    three DEFAULT-precision bf16 matmuls, since the other operand is
+    EXACTLY representable in bf16 (one-hot 0/1). Where an output element
+    selects a single column (the gather), (hi+mid)+lo reconstructs the
+    f32 value BIT-exactly; where it sums several columns (the scatter,
+    duplicate slots in a chunk), each column's contribution is exact and
+    only the f32 summation ORDER differs from a direct accumulation —
+    the same ≤1-ulp-per-add reorder class as any parallel reduction.
+    Cost: 3 bf16 MXU passes — about half of Precision.HIGHEST (which
+    decomposes BOTH operands), Mosaic's only other non-DEFAULT option.
+
+    `bf16=True` (cfg.data.sorted_bf16): one rounded pass — values carry
+    8 mantissa bits, the standard bf16-training trade, +24% FM
+    throughput. The flag is threaded as a static argument (never a
+    global) so each jitted step keeps the precision of the config it was
+    built with."""
+    oh = onehot_f32.astype(jnp.bfloat16)
+
+    def one(term):
+        return jax.lax.dot_general(
+            term, oh, dims, preferred_element_type=jnp.float32
+        )
+
+    if bf16:
+        return one(a.astype(jnp.bfloat16))
+    hi = a.astype(jnp.bfloat16)
+    rem = a - hi.astype(jnp.float32)
+    mid = rem.astype(jnp.bfloat16)
+    lo = (rem - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return (one(hi) + one(mid)) + one(lo)
+
+def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, acc, old, sem_s, sem_d,
+                   *, bf16):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -324,9 +360,14 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, acc, old, sem_s,
         onehot = (
             jax.lax.broadcasted_iota(jnp.int32, (WINDOW, CHUNK), 0) == rel
         ).astype(jnp.float32)  # [W, C]
-        occ = jax.lax.dot_general(
-            table_ref[:, :], onehot, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        # f32-accurate selection via 3 bf16 passes (_dot_f32): the MXU's
+        # default bf16 pass would round every gathered table value to 8
+        # mantissa bits (caught by an on-device parity check vs the XLA
+        # gather, ~2^-8 rel error — CPU tests are f32-exact and cannot
+        # see it); Precision.HIGHEST is exact too but costs ~2x this,
+        # and Mosaic rejects Precision.HIGH
+        occ = _dot_f32(
+            table_ref[:, :], onehot, (((0,), (0,)), ((), ())), bf16
         )  # [K, C]
         acc[0:K, :] = occ
         acc[K:, :] = jnp.zeros((acc.shape[0] - K, CHUNK), jnp.float32)
@@ -343,7 +384,7 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, acc, old, sem_s,
     jax.lax.fori_loop(0, n_chunks, chunk_step, 0)
 
 
-def _gather_pallas(table, sorted_slots, win_off):
+def _gather_pallas(table, sorted_slots, win_off, bf16=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -368,14 +409,14 @@ def _gather_pallas(table, sorted_slots, win_off):
         ],
     )
     return pl.pallas_call(
-        _gather_kernel,
+        partial(_gather_kernel, bf16=bf16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((K8, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
     )(win_off, sorted_slots.reshape(1, n), table)
 
 
-def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d):
+def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d, *, bf16):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -400,9 +441,11 @@ def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d):
             jax.lax.broadcasted_iota(jnp.int32, (WINDOW, CHUNK), 0) == rel
         ).astype(jnp.float32)  # [W, C]
         # [K8, C] x [W, C] contracting C -> [K8, W]
-        return acc_t + jax.lax.dot_general(
-            dch[:, :], onehot, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        # f32-accurate for the same reason as the gather; duplicate slots
+        # in a chunk make this a SUM, so vs XLA's scatter only the f32
+        # accumulation order differs (<= 1 ulp/add — see _dot_f32)
+        return acc_t + _dot_f32(
+            dch[:, :], onehot, (((1,), (1,)), ((), ())), bf16
         )
 
     acc_t = jnp.zeros((K8, WINDOW), jnp.float32)
@@ -410,7 +453,7 @@ def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d):
     out_ref[:, :] = acc_t[0:K, :].T  # [W, K]
 
 
-def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int):
+def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -432,7 +475,7 @@ def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int):
         ],
     )
     return pl.pallas_call(
-        _scatter_kernel,
+        partial(_scatter_kernel, bf16=bf16),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_slots, k), jnp.float32),
     )(win_off, sorted_slots.reshape(1, n), d_occ_t)
@@ -538,30 +581,32 @@ row_sums_sorted.defvjp(_rowsum_fwd, _rowsum_bwd)
 
 # ------------------------------------------------------------ public op
 
-@partial(jax.custom_vjp, nondiff_argnums=())
-def table_gather_sorted(table, sorted_slots, win_off):
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def table_gather_sorted(table, sorted_slots, win_off, bf16=False):
     """Per-occurrence table rows, transposed: [K8, Np] for slot-sorted
     occurrences. Differentiable in `table`; the VJP is the windowed
     scatter-add. Rows K..K8 are zero. Padded columns (positions past the
     batch's real occurrences) hold row `S-1`'s values, not zeros —
-    multiply by `sorted_mask` before use."""
+    multiply by `sorted_mask` before use. `bf16` (static — thread
+    cfg.data.sorted_bf16 here) trades the f32-accurate 3-pass MXU
+    contraction for one rounded pass (see `_dot_f32`)."""
     if _on_tpu():
-        return _gather_pallas(table, sorted_slots, win_off)
+        return _gather_pallas(table, sorted_slots, win_off, bf16)
     return _gather_xla(table, sorted_slots, win_off)
 
 
-def _gather_fwd(table, sorted_slots, win_off):
-    return table_gather_sorted(table, sorted_slots, win_off), (
+def _gather_fwd(table, sorted_slots, win_off, bf16=False):
+    return table_gather_sorted(table, sorted_slots, win_off, bf16), (
         sorted_slots,
         win_off,
         table.shape,
     )
 
 
-def _gather_bwd(res, d_occ_t):
+def _gather_bwd(bf16, res, d_occ_t):
     sorted_slots, win_off, (num_slots, k) = res
     if _on_tpu():
-        d_table = _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k)
+        d_table = _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k, bf16)
     else:
         d_table = _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k)
     return d_table, None, None

@@ -126,7 +126,9 @@ def make_sorted_sharded_train_step(
         # never touch (their chunk ranges come from off_local) and the
         # in-span mask removes from compute
         slots_local = sorted_slots - t_idx * S_local
-        occ_t = table_gather_sorted(wv_local, slots_local, off_local)  # [K8, Np_l]
+        occ_t = table_gather_sorted(
+            wv_local, slots_local, off_local, cfg.data.sorted_bf16
+        )  # [K8, Np_l]
         pos = jnp.arange(sorted_slots.shape[0], dtype=jnp.int32)
         in_span = (pos >= off_local[0]) & (pos < off_local[-1])
         # where() (not multiply) so untouched positions — which may hold
