@@ -234,7 +234,15 @@ def main() -> int:
             state, losses = run_k_steps(state, batches)
             _ = float(losses[-1])  # host read = hard sync
             times = []
-            for _ in range(args.repeats):
+            # companion runs (non-headline model or zipf) use fewer
+            # repeats: the full 3-model x 2-dist sweep must fit a
+            # single driver invocation comfortably
+            reps = (
+                args.repeats
+                if (name == "lr" and dist == "uniform") or args.model != "all"
+                else min(args.repeats, 3)
+            )
+            for _ in range(reps):
                 t0 = time.perf_counter()
                 state, losses = run_k_steps(state, batches)
                 _ = float(losses[-1])
