@@ -84,3 +84,14 @@ def test_bucketed_eval_single_class_nan(tmp_path):
     auc, ll = t.evaluate()
     assert np.isnan(auc)
     assert np.isfinite(ll)
+
+
+def test_resolve_eval_buckets_auto():
+    """-1 = auto: exact single-process, bucketed multi-process so the
+    default pod-scale config has no per-batch eval collectives."""
+    from xflow_tpu.train.trainer import resolve_eval_buckets
+
+    assert resolve_eval_buckets(-1, multiproc=False) == 0
+    assert resolve_eval_buckets(-1, multiproc=True) == 65536
+    assert resolve_eval_buckets(0, multiproc=True) == 0  # explicit exact wins
+    assert resolve_eval_buckets(1024, multiproc=False) == 1024

@@ -83,6 +83,10 @@ def test_launch_local_two_process_matches_single_process(tmp_path):
         ["launch-local", "--num-processes", "2", "--",
          "--train", str(tmp_path / "train"), "--test", str(tmp_path / "test"),
          "--batch-size", str(B), "--checkpoint-dir", str(tmp_path / "ckpt2p"),
+         # pin EXACT eval: this is the bit-match gate, and the multi-
+         # process default (eval_buckets auto) is bucketed — its AUC
+         # differs by bucket quantization, not a training divergence
+         "--set", "train.eval_buckets=0",
          *TRAIN_ARGS],
         tmp_path,
     )

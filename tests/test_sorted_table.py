@@ -463,3 +463,26 @@ def test_table_gather_sorted_bf16_flag_smoke():
 
     g = jax.grad(f)(jnp.asarray(table))
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_native_plan_rejects_out_of_range_slots():
+    """An out-of-range slot must fail loudly: the radix sort masks each
+    11-bit digit, so without validation a bad slot (possible only via a
+    buggy caller — the parser hashes into range) would be silently
+    aliased into a wrong window and its gradient scattered to a wrong
+    table row (advisor r2)."""
+    native = pytest.importorskip("xflow_tpu.data.native")
+    try:
+        native.get_lib()
+    except Exception:
+        pytest.skip("native library not built")
+    from xflow_tpu.ops.sorted_table import padded_len
+
+    for bad in (-1, S, S + 7):
+        slots = np.zeros((4, 4), np.int32)
+        slots[2, 1] = bad
+        mask = np.ones((4, 4), np.float32)
+        with pytest.raises(ValueError):
+            native.native_plan_sorted(
+                slots, mask, None, S, WINDOW, padded_len(slots.size)
+            )

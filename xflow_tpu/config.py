@@ -159,8 +159,12 @@ class TrainConfig:
     pred_dump: bool = True  # write pred_<rank>_<block>.txt like lr_worker.cc:74-78
     # >0: streaming bucketed eval (local histograms + one collective; no
     # host ever holds the global pctr vector — the Criteo-1TB-scale path).
-    # 0: exact rank-sum AUC with a host sort (reference parity, base.h:84-110)
-    eval_buckets: int = 0
+    # 0: exact rank-sum AUC with a host sort (reference parity,
+    # base.h:84-110). -1 (default) = auto: exact when single-process,
+    # 65536 buckets when multi-process — the exact path allgathers a
+    # stacked [B, 3] array per eval batch, which dead-ends before
+    # pod-scale eval (AUC error is bounded by bucket width, ~1/buckets).
+    eval_buckets: int = -1
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
     profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
     # preemption: on SIGTERM/SIGINT save a checkpoint at the next step

@@ -203,7 +203,7 @@ def main(argv=None) -> int:
     gd.add_argument("--shards", type=int, default=3)
     gd.add_argument("--rows", type=int, default=1000)
     gd.add_argument("--fields", type=int, default=18)
-    gd.add_argument("--ids-per-field", type=int, default=10_000)
+    gd.add_argument("--ids-per-field", type=int, default=500)
     gd.add_argument("--seed", type=int, default=0)
     gd.add_argument("--truth-seed", type=int, default=None,
                     help="seed for the planted ground truth (default: --seed); use the "

@@ -565,6 +565,13 @@ long xf_plan_sorted(const int32_t* slots, const float* mask, const int32_t* fiel
       num_slots % window != 0) {
     return -1;
   }
+  // validate slot range up front: the radix sort masks each 11-bit digit,
+  // so an out-of-range slot would otherwise be silently aliased into a
+  // wrong window (and its gradient scattered to a wrong table row) —
+  // loud failure matches this function's convention (advisor r2)
+  for (long i = 0; i < n; ++i) {
+    if (slots[i] < 0 || slots[i] >= num_slots) return -1;
+  }
   constexpr int kDigitBits = 11;
   constexpr int kRadix = 1 << kDigitBits;
   std::vector<int32_t> order(n), scratch(n);

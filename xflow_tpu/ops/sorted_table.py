@@ -77,20 +77,25 @@ def padded_len(n: int) -> int:
 
 
 _NATIVE_PLAN = None  # tri-state: None = untried, False = unavailable, else fn
-_PLAN_POOL = None  # cached executor: one per process, not one per batch
-_PLAN_POOL_WORKERS = 0
+_PLAN_POOL = None  # one fixed-size executor per process, created once
+_PLAN_POOL_LOCK = __import__("threading").Lock()
 
 
 def _plan_pool(workers: int):
-    global _PLAN_POOL, _PLAN_POOL_WORKERS
-    if _PLAN_POOL is None or _PLAN_POOL_WORKERS < workers:
-        from concurrent.futures import ThreadPoolExecutor
+    """Shared planning thread pool, sized once to the host's cores and
+    NEVER shut down: a resize-by-replacement would race concurrent
+    Trainers' in-flight map() futures against the old pool's shutdown
+    (advisor r2). Oversubscription is impossible (cores is the useful
+    ceiling regardless of any caller's num_sub); `workers` only matters
+    the first call, as a floor for tiny-cpu_count() hosts."""
+    global _PLAN_POOL
+    with _PLAN_POOL_LOCK:
+        if _PLAN_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
 
-        if _PLAN_POOL is not None:
-            _PLAN_POOL.shutdown(wait=False)
-        _PLAN_POOL = ThreadPoolExecutor(max_workers=workers)
-        _PLAN_POOL_WORKERS = workers
-    return _PLAN_POOL
+            size = max(workers, min(os.cpu_count() or 1, 16))
+            _PLAN_POOL = ThreadPoolExecutor(max_workers=size)
+        return _PLAN_POOL
 
 
 def _native_planner():
@@ -141,6 +146,15 @@ def plan_sorted_batch(
         return SortedPlan(ss, row, m, off, f)
     flat_slots = np.ascontiguousarray(slots, np.int32).ravel()
     flat_mask = np.ascontiguousarray(mask, np.float32).ravel()
+    if flat_slots.size and (
+        int(flat_slots.min()) < 0 or int(flat_slots.max()) >= num_slots
+    ):
+        # same loud-failure contract as the native planner: an out-of-range
+        # slot would sort past the last window and be silently dropped
+        raise ValueError(
+            f"slot out of range [0, {num_slots}): "
+            f"min={int(flat_slots.min())} max={int(flat_slots.max())}"
+        )
     n = flat_slots.shape[0]
     np_len = padded_len(n)
     order = np.argsort(flat_slots, kind="stable").astype(np.int32)
@@ -562,8 +576,9 @@ def row_sums_sorted(vals_t, rows, num_rows):
     # regardless of ch (lane padding); 64k rows = 33.5 MB is measured to
     # fit on v5e, 2× that failed to compile (tools/rowsum_probe.py) —
     # larger batches fall back to the XLA segment-sum rather than dying
-    # in Mosaic
-    if _on_tpu() and num_rows <= 65536:
+    # in Mosaic. num_rows % 8: a non-sublane-aligned accumulator block
+    # (e.g. batch_size=50) would also fail deep in Mosaic (advisor r2).
+    if _on_tpu() and num_rows <= 65536 and num_rows % 8 == 0:
         return _rowsum_pallas(vals_t, rows, num_rows)
     return jax.ops.segment_sum(vals_t.T, rows, num_segments=num_rows)
 

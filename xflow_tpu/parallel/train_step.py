@@ -79,17 +79,19 @@ def make_sharded_eval_step(model: Model, cfg: Config, mesh: Mesh) -> Callable:
     cache = {}
 
     def call(tables, batch):
-        key = frozenset(batch)
+        # accept the tables AS SHARDED (jit with explicit in_shardings
+        # rejects mismatches instead of resharding): the GSPMD eval
+        # forward partitions fine under either the default
+        # P(('data','table')) layout or the sorted engine's
+        # P('table', None). The live shardings are part of the cache key:
+        # a restore/device_put that reshards the tables mid-lifetime gets
+        # a fresh jit instead of an in_shardings mismatch error (advisor r2).
+        tsh = jax.tree.map(
+            lambda x: x.sharding if hasattr(x, "sharding") else replicated(mesh),
+            tables,
+        )
+        key = (frozenset(batch), tuple(jax.tree.leaves(tsh)))
         if key not in cache:
-            # accept the tables AS SHARDED (jit with explicit in_shardings
-            # rejects mismatches instead of resharding): the GSPMD eval
-            # forward partitions fine under either the default
-            # P(('data','table')) layout or the sorted engine's
-            # P('table', None)
-            tsh = jax.tree.map(
-                lambda x: x.sharding if hasattr(x, "sharding") else replicated(mesh),
-                tables,
-            )
             cache[key] = jax.jit(
                 ev,
                 in_shardings=(tsh, {k: bsh[k] for k in batch}),

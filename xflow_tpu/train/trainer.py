@@ -49,6 +49,16 @@ class TrainResult:
         return self.examples / self.seconds if self.seconds > 0 else 0.0
 
 
+def resolve_eval_buckets(value: int, multiproc: bool) -> int:
+    """train.eval_buckets -1 = auto: exact single-process; bucketed
+    (65536) multi-process, so the default pod-scale config has ZERO
+    per-batch host collectives (the exact path allgathers a stacked
+    [B, 3] array per eval batch — round-2 weak #5). Depends only on
+    config + process count, identical on every process — a per-rank
+    choice would mismatch the collective sequences and deadlock."""
+    return value if value >= 0 else (65536 if multiproc else 0)
+
+
 class MetricsLogger:
     """Structured per-step metrics: JSONL to a file, or quiet."""
 
@@ -446,8 +456,9 @@ class Trainer:
         path = test_path or shard_path(cfg.data.test_path, self.rank)
         dump = cfg.train.pred_dump if dump is None else dump
         multiproc = jax.process_count() > 1
-        if cfg.train.eval_buckets:
-            return self._evaluate_bucketed(path, cfg.train.eval_buckets, dump, block)
+        buckets = resolve_eval_buckets(cfg.train.eval_buckets, multiproc)
+        if buckets:
+            return self._evaluate_bucketed(path, buckets, dump, block)
         dump = dump and (not multiproc or self.rank == 0)
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
