@@ -22,6 +22,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from xflow_tpu.data.synth import generate_shards
 
@@ -157,15 +158,20 @@ def test_launch_local_ragged_and_missing_shards(tmp_path):
     assert json.loads(r.stdout.strip().splitlines()[-1])["steps"] == 3
 
 
-def test_launch_local_two_process_sorted_engine(tmp_path):
-    """Multi-process sorted-sharded engine: 2 processes × 1 device, mesh
+@pytest.mark.parametrize("engine", ["fullshard", "replicated"])
+def test_launch_local_two_process_sorted_engine(tmp_path, engine):
+    """Multi-process sorted engines: 2 processes × 1 device, mesh
     (data=2, table=1), fused FM with sorted_layout=on — final tables
-    match a single-process sorted run on the batch-composed data."""
+    match a single-process sorted run on the batch-composed data.
+    Covers BOTH mesh engines: fullshard (table sharded over the whole
+    mesh, occurrence all_to_all crossing the process boundary) and
+    replicated (table on the 'table' axis only)."""
     B, rows = 32, 96
     fm_args = [
         "--model", "fm", "--epochs", "2", "--log2-slots", "13",
         "--set", "model.num_fields=4", "--set", "data.max_nnz=8",
         "--set", "train.pred_dump=false", "--set", "data.sorted_layout=on",
+        "--set", f"data.sorted_mesh={engine}",
     ]
     generate_shards(str(tmp_path / "train"), 2, rows, num_fields=4, ids_per_field=50)
     r2 = run_cli(

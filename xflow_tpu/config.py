@@ -130,6 +130,24 @@ class DataConfig:
     # smallest power of two keeping B/NS·nf·(k+1)·4B under 16 MiB — the
     # measured sweet spot on v5e, docs/PERF.md).
     sorted_sub_batches: int = 0
+    # which sorted engine runs on a device mesh:
+    # - "fullshard" (default): table + optimizer state sharded over the
+    #   WHOLE mesh, P(('data','table')) — each device owns S/(D*T) slots,
+    #   occurrences travel to their slot owners by one all_to_all, row
+    #   aggregates return by one psum_scatter + psum, and the table
+    #   gradient never leaves its device (parallel/sorted_fullshard.py).
+    #   The 1B-feature regime (12 GB+ FTRL state) requires this layout.
+    # - "replicated": table sharded on the 'table' axis only, replicated
+    #   across 'data' (D× table memory; parallel/sorted_sharded.py) —
+    #   fewer collectives, viable when the table fits per-device HBM.
+    sorted_mesh: str = "fullshard"
+    # per-(source shard, owner block) occurrence buffer capacity, as a
+    # multiple of the uniform-hash expectation Np/(D*T). Salted hashing
+    # spreads slots near-uniformly, but a single hot feature's
+    # occurrences all land in ONE owner block (the ps-lite analog has the
+    # same imbalance: one server owns the hot key) — raise this for
+    # heavily skewed data; overflow fails loudly at plan time.
+    fullshard_slack: float = 2.0
 
 
 @dataclass(frozen=True)

@@ -178,6 +178,17 @@ def plan_sorted_batch(
     )
 
 
+def map_host_parallel(fn, n: int) -> list:
+    """Run fn(0..n-1) on the shared planning pool when the C planner is
+    built (it releases the GIL during the sort, so plans parallelize
+    across host cores); the numpy fallback holds the GIL through
+    argsort, where threads would only add churn. Order-preserving."""
+    workers = min(n, os.cpu_count() or 1)
+    if workers > 1 and _native_planner():
+        return list(_plan_pool(workers).map(fn, range(n)))
+    return [fn(i) for i in range(n)]
+
+
 def plan_sorted_stacked(
     slots: np.ndarray,
     mask: np.ndarray,
@@ -221,13 +232,8 @@ def plan_sorted_stacked(
             fields=None if fields is None else fields[i * bs : (i + 1) * bs],
         )
 
-    workers = min(num_sub, os.cpu_count() or 1)
-    if workers > 1 and _native_planner() and num_slots % WINDOW == 0:
-        # the C planner (xf_plan_sorted) releases the GIL during the sort,
-        # so sub-batch plans parallelize across host cores; the numpy
-        # fallback holds the GIL through argsort, where threads would only
-        # add churn. ex.map preserves sub-batch order.
-        plans = list(_plan_pool(workers).map(one, range(num_sub)))
+    if num_slots % WINDOW == 0:
+        plans = map_host_parallel(one, num_sub)
     else:
         plans = [one(i) for i in range(num_sub)]
     return SortedPlan(
@@ -352,13 +358,17 @@ def _dot_f32(a, onehot_f32, dims, bf16: bool):
     return (one(hi) + one(mid)) + one(lo)
 
 def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, acc, old, sem_s, sem_d,
-                   *, bf16):
+                   *, bf16, n_tw):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     t = pl.program_id(0)
     K = table_ref.shape[1]
-    base = t * WINDOW
+    # t % n_tw: the grid may sweep the table's windows SEVERAL times (the
+    # fully-sharded engine concatenates D per-source-shard occurrence
+    # buffers that each span the same local table shard); in the
+    # single-stream case the grid size equals n_tw and this is identity
+    base = (t % n_tw) * WINDOW
     start, end = off_ref[t], off_ref[t + 1]
     astart = (start // CHUNK) * CHUNK  # aligned down: extras self-mask
     n_chunks = pl.cdiv(end - astart, CHUNK)
@@ -404,14 +414,17 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False):
 
     S, K = table.shape
     K8 = _k8(K)
-    n_win = S // WINDOW
+    n_tw = S // WINDOW
+    # grid = logical windows = len(win_off)-1; a multiple of n_tw when the
+    # occurrence stream is D concatenated buffers over the same table
+    n_win = win_off.shape[0] - 1
     n = sorted_slots.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_win,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
-            pl.BlockSpec((WINDOW, K), lambda t, off: (t, 0)),  # table window
+            pl.BlockSpec((WINDOW, K), lambda t, off: (t % n_tw, 0)),  # table window
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),  # occ_t [K8, Np]
         scratch_shapes=[
@@ -423,26 +436,26 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False):
         ],
     )
     return pl.pallas_call(
-        partial(_gather_kernel, bf16=bf16),
+        partial(_gather_kernel, bf16=bf16, n_tw=n_tw),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((K8, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
     )(win_off, sorted_slots.reshape(1, n), table)
 
 
-def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d, *, bf16):
+def _scatter_span(slots_ref, d_ref, slc, dch, sem_s, sem_d, base, start, end,
+                  acc_t, bf16):
+    """Accumulate one occurrence span's contribution to the window at
+    `base` into acc_t [K8, W] — the precision-critical DMA + one-hot +
+    `_dot_f32` sequence shared by the single-stream and multi-buffer
+    scatter kernels (a fix here fixes both)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    t = pl.program_id(0)
-    K8 = d_ref.shape[0]
-    K = out_ref.shape[1]
-    base = t * WINDOW
-    start, end = off_ref[t], off_ref[t + 1]
     astart = (start // CHUNK) * CHUNK
     n_chunks = pl.cdiv(end - astart, CHUNK)
 
-    def chunk_step(c, acc_t):
+    def chunk_step(c, acc):
         o = astart + c * CHUNK
         cp_s = pltpu.make_async_copy(slots_ref.at[:, pl.ds(o, CHUNK)], slc, sem_s)
         cp_s.start()
@@ -458,12 +471,22 @@ def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d, 
         # f32-accurate for the same reason as the gather; duplicate slots
         # in a chunk make this a SUM, so vs XLA's scatter only the f32
         # accumulation order differs (<= 1 ulp/add — see _dot_f32)
-        return acc_t + _dot_f32(
-            dch[:, :], onehot, (((1,), (1,)), ((), ())), bf16
-        )
+        return acc + _dot_f32(dch[:, :], onehot, (((1,), (1,)), ((), ())), bf16)
 
+    return jax.lax.fori_loop(0, n_chunks, chunk_step, acc_t)
+
+
+def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d, *, bf16):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    K8 = d_ref.shape[0]
+    K = out_ref.shape[1]
     acc_t = jnp.zeros((K8, WINDOW), jnp.float32)
-    acc_t = jax.lax.fori_loop(0, n_chunks, chunk_step, acc_t)
+    acc_t = _scatter_span(
+        slots_ref, d_ref, slc, dch, sem_s, sem_d,
+        t * WINDOW, off_ref[t], off_ref[t + 1], acc_t, bf16,
+    )
     out_ref[:, :] = acc_t[0:K, :].T  # [W, K]
 
 
@@ -493,6 +516,67 @@ def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=Fals
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_slots, k), jnp.float32),
     )(win_off, sorted_slots.reshape(1, n), d_occ_t)
+
+
+def _scatter_kernel_multi(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d,
+                          *, bf16, nbuf, cap):
+    """Windowed scatter over `nbuf` concatenated per-source buffers.
+
+    The fully-sharded engine's cotangent stream is nbuf buffers of `cap`
+    positions each, all targeting the SAME local table shard; grid step j
+    owns table window j and accumulates the matching span of every
+    buffer before one [W, K] block write — each output block is visited
+    exactly once, so no cross-step revisit semantics are needed.
+    `off_ref` is [nbuf, wpo+1] buffer-local window offsets with
+    off_ref[i, wpo] extended to `cap` (pads ride in the last window)."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(0)
+    K8 = d_ref.shape[0]
+    K = out_ref.shape[1]
+
+    def buf_step(i, acc_t):
+        # aligned-down reads stay >= i*cap (cap % CHUNK == 0)
+        return _scatter_span(
+            slots_ref, d_ref, slc, dch, sem_s, sem_d,
+            j * WINDOW, i * cap + off_ref[i, j], i * cap + off_ref[i, j + 1],
+            acc_t, bf16,
+        )
+
+    acc_t = jnp.zeros((K8, WINDOW), jnp.float32)
+    acc_t = jax.lax.fori_loop(0, nbuf, buf_step, acc_t)
+    out_ref[:, :] = acc_t[0:K, :].T
+
+
+def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf16=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    K8, n = d_occ_t.shape
+    nbuf, wpo1 = loc_off.shape
+    n_win = num_slots // WINDOW
+    assert wpo1 == n_win + 1, (loc_off.shape, n_win)
+    assert cap % CHUNK == 0 and nbuf * cap == n, (nbuf, cap, n)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_win,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
+            pl.BlockSpec(memory_space=pl.ANY),  # d [K8, Np]
+        ],
+        out_specs=pl.BlockSpec((WINDOW, k), lambda t, off: (t, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, CHUNK), jnp.int32),
+            pltpu.VMEM((K8, CHUNK), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        partial(_scatter_kernel_multi, bf16=bf16, nbuf=nbuf, cap=cap),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_slots, k), jnp.float32),
+    )(loc_off, sorted_slots.reshape(1, n), d_occ_t)
 
 
 def _on_tpu() -> bool:
@@ -628,3 +712,61 @@ def _gather_bwd(bf16, res, d_occ_t):
 
 
 table_gather_sorted.defvjp(_gather_fwd, _gather_bwd)
+
+
+# ------------------------------------------- multi-buffer op (fullshard)
+
+def _multi_off_flat(loc_off, cap):
+    """[nbuf, wpo+1] buffer-local offsets -> [nbuf*wpo + 1] positions in
+    the concatenated stream. loc_off[i, 0] == 0 and loc_off[i, wpo] ==
+    cap (host contract: pads are owned by the last window), so the
+    intervals are consecutive and cover [0, nbuf*cap) exactly."""
+    nbuf, wpo1 = loc_off.shape
+    wpo = wpo1 - 1
+    starts = jnp.arange(nbuf, dtype=jnp.int32)[:, None] * cap + loc_off[:, :wpo]
+    return jnp.concatenate(
+        [starts.reshape(-1), jnp.array([nbuf * cap], jnp.int32)]
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def table_gather_sorted_multi(table, sorted_slots, loc_off, bf16=False):
+    """`table_gather_sorted` over a concatenated multi-buffer stream: the
+    fully-sharded engine's per-device input is `nbuf` fixed-capacity
+    buffers (one per source data shard, each slot-sorted over THIS
+    device's local table shard, pads at slot S_local-1 / mask 0). The
+    gather sweeps the local windows once per buffer (wrap-around window
+    indexing); the VJP accumulates every buffer's span into one [W, K]
+    block write per local window (`_scatter_kernel_multi`) — the
+    table-shard gradient never leaves the device.
+
+    `loc_off` [nbuf, wpo+1]: buffer-local window offsets, last entry
+    extended to `cap`. Capacity = sorted_slots.size // nbuf, a CHUNK
+    multiple (host contract, parallel/sorted_fullshard.py)."""
+    if _on_tpu():
+        cap = sorted_slots.shape[0] // loc_off.shape[0]
+        return _gather_pallas(table, sorted_slots, _multi_off_flat(loc_off, cap), bf16)
+    return _gather_xla(table, sorted_slots, None)
+
+
+def _gather_multi_fwd(table, sorted_slots, loc_off, bf16=False):
+    return table_gather_sorted_multi(table, sorted_slots, loc_off, bf16), (
+        sorted_slots,
+        loc_off,
+        table.shape,
+    )
+
+
+def _gather_multi_bwd(bf16, res, d_occ_t):
+    sorted_slots, loc_off, (num_slots, k) = res
+    if _on_tpu():
+        cap = sorted_slots.shape[0] // loc_off.shape[0]
+        d_table = _scatter_pallas_multi(
+            d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf16
+        )
+    else:
+        d_table = _scatter_xla(d_occ_t, sorted_slots, None, num_slots, k)
+    return d_table, None, None
+
+
+table_gather_sorted_multi.defvjp(_gather_multi_fwd, _gather_multi_bwd)

@@ -61,6 +61,9 @@ def batch_sharding(mesh: Mesh) -> dict:
     """
     row2d = NamedSharding(mesh, P(DATA_AXIS, None))
     row1d = NamedSharding(mesh, P(DATA_AXIS))
+    # fullshard buffers [D_src, T, D_dst, cap]: source shard on 'data',
+    # destination column on 'table' (parallel/sorted_fullshard.py)
+    fs4 = NamedSharding(mesh, P(DATA_AXIS, TABLE_AXIS, None, None))
     return {
         "slots": row2d,
         "fields": row2d,
@@ -72,6 +75,11 @@ def batch_sharding(mesh: Mesh) -> dict:
         "sorted_mask": row2d,
         "sorted_fields": row2d,
         "win_off": row2d,
+        "fs_slots": fs4,
+        "fs_row": fs4,
+        "fs_mask": fs4,
+        "fs_off": fs4,
+        "fs_fields": fs4,
     }
 
 

@@ -1,0 +1,394 @@
+"""Fully-sharded sorted-window training: the pod-scale fast path.
+
+The table AND its optimizer state shard over the WHOLE mesh —
+``P(('data','table'), None)``, each device owning ``S/(D*T)`` slots =
+``wpo`` whole windows — with NO replication anywhere (the 1B-feature /
+12 GB-FTRL-state north-star regime only fits HBM this way; SURVEY.md §7
+hard part d). This is the direct analog of ps-lite sharding the uint64
+key space across *all* servers with no replication (SURVEY.md §2 C13),
+where `parallel/sorted_sharded.py` replicates the table across 'data'
+(D× memory) to save collectives.
+
+Data flow per step, device (d, t), owner block o = d*T + t:
+
+1. HOST: each data shard's occurrences are slot-sorted once
+   (`plan_sorted_batch`, the same plan the single-chip engine uses) and
+   then sliced at owner-block boundaries — a block's occurrences are one
+   CONTIGUOUS span of the sorted stream — into fixed-capacity buffers
+   ``[T, D_dst, cap]`` (`fullshard_buffers`). Pads carry the block's
+   last local slot with mask 0, the same convention as plan pads.
+2. ONE `all_to_all` over 'data' delivers to device (d, t) the D buffers
+   (one per source shard) targeting ITS block — occurrence-scale
+   traffic (~12 B/occurrence · slack), the synchronous analog of every
+   worker Pulling from the server that owns each key
+   (`lr_worker.cc:170`), batched into one collective.
+3. The Pallas sorted-window kernels run UNMODIFIED on the local
+   ``[S/(D*T), K]`` table shard over the concatenated buffer stream
+   (`table_gather_sorted_multi`: wrap-around window indexing; the VJP
+   accumulates all buffers into one block write per local window).
+4. Per-row partial sums for ALL source shards are reduced to their row
+   owners by ONE `psum_scatter` over 'data' + ONE `psum` over 'table'
+   (~B·ch·4 B each) — aggregated rows cross the wire, never table rows.
+5. Backward: the transpose all-gathers the small [R, ch] row cotangent
+   over 'data'; the table gradient is a SHARD-LOCAL scatter — no
+   table-scale collective exists in either direction.
+
+Load imbalance, stated plainly: hashing spreads slots near-uniformly
+across owner blocks, but a hot feature's occurrences all land in one
+block (ps-lite has the identical imbalance: one server owns the hot
+key). `data.fullshard_slack` sizes the buffers; overflow fails loudly
+at plan time with the slack to raise. Host-side dedup shrinks exactly
+this traffic on skewed data (docs/PERF.md lever 4).
+
+Supports fused FM and MVM (sorted-engine models). LR stays on the GSPMD
+row-major path: its 1-D table gather is already bandwidth-efficient
+(2.2× the per-chip target, BENCH_r02) and needs no windowed engine.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from xflow_tpu.config import Config
+from xflow_tpu.metrics import binary_logloss_from_logits
+from xflow_tpu.ops.sorted_table import (
+    CHUNK,
+    WINDOW,
+    SortedPlan,
+    map_host_parallel,
+    plan_sorted_batch,
+    row_sums_sorted,
+    table_gather_sorted_multi,
+)
+from xflow_tpu.parallel.mesh import DATA_AXIS, TABLE_AXIS
+from xflow_tpu.train.state import TrainState
+
+FS_KEYS = ("fs_slots", "fs_row", "fs_mask", "fs_off")
+
+
+class FullshardOverflowError(ValueError):
+    """An owner block's occurrences exceed the buffer capacity (data more
+    skewed than data.fullshard_slack allows). Distinct from other config
+    errors so the trainer can fall back to the GSPMD row-major step for
+    the offending batch (single-process only — a per-process fallback
+    would desync the collective programs across ranks)."""
+
+
+def _dims(cfg: Config, mesh: Mesh):
+    d, t = mesh.shape[DATA_AXIS], mesh.shape[TABLE_AXIS]
+    p = jax.process_count()
+    return d, t, p
+
+
+def validate_sorted_fullshard(cfg: Config, mesh: Mesh) -> None:
+    """Reject configs the fully-sharded engine cannot run, with the
+    specific reason (mirrors validate_sorted_sharded)."""
+    d, t, p = _dims(cfg, mesh)
+    S = cfg.num_slots
+    if S % (d * t * WINDOW) != 0:
+        raise ValueError(
+            f"fullshard layout needs num_slots (2^{cfg.data.log2_slots}) "
+            f"divisible by data*table*WINDOW = {d}*{t}*{WINDOW} (each device "
+            "owns whole windows)"
+        )
+    if cfg.model.name == "fm":
+        if not cfg.model.fm_fused:
+            raise ValueError("fullshard FM needs model.fm_fused=true (one table)")
+    elif cfg.model.name != "mvm":
+        raise ValueError(
+            "fullshard layout supports fused FM and MVM (LR keeps the GSPMD "
+            f"row-major path); got model={cfg.model.name}"
+        )
+    if d % p != 0:
+        raise ValueError(
+            f"fullshard layout needs the data axis ({d}) divisible by the "
+            f"process count ({p}): each process plans its rows into d/P shards"
+        )
+    if cfg.data.batch_size % (d // p) != 0:
+        raise ValueError(
+            f"per-process batch_size {cfg.data.batch_size} not divisible by "
+            f"the local data-shard count {d // p}"
+        )
+    if cfg.data.sorted_sub_batches not in (0, d // p):
+        raise ValueError(
+            f"data.sorted_sub_batches={cfg.data.sorted_sub_batches} conflicts "
+            f"with the fullshard plan count (= {d // p} per process); leave it 0"
+        )
+    if cfg.data.fullshard_slack < 1.0:
+        raise ValueError(
+            f"data.fullshard_slack={cfg.data.fullshard_slack} < 1 cannot hold "
+            "even perfectly uniform occupancy"
+        )
+
+
+def fullshard_capacity(cfg: Config, mesh: Mesh) -> int:
+    """Per-(source shard, owner block) buffer capacity: a CHUNK multiple
+    covering `slack`× the uniform-hash expectation, plus one spare CHUNK
+    for the plan pads that ride in the stream's last block."""
+    d, t, p = _dims(cfg, mesh)
+    rows = cfg.data.batch_size // (d // p)
+    expect = rows * cfg.data.max_nnz / (d * t)  # real occurrences only:
+    # plan pads are NOT copied into the buffers (fullshard_buffers clamps
+    # spans to n_real; each buffer carries its own pads past its span)
+    cap = int(np.ceil(cfg.data.fullshard_slack * expect / CHUNK)) * CHUNK
+    return max(cap, CHUNK) + CHUNK
+
+
+def fullshard_buffers(
+    plan: SortedPlan,
+    D: int,
+    T: int,
+    cap: int,
+    s_local: int,
+    slack: float,
+    with_fields: bool = False,
+    *,
+    n_real: int,
+) -> dict:
+    """Slice ONE shard's flat sorted plan at owner-block boundaries into
+    per-destination buffers.
+
+    Returns ``fs_slots/fs_row/fs_mask`` ``[T, D, cap]`` (+ ``fs_fields``)
+    and ``fs_off`` ``[T, D, wpo+1]``: buffer-local window offsets with
+    the last entry extended to `cap`, so the block's last window owns the
+    pads (pad slot = s_local-1, mask 0 — the plan-pad convention).
+    """
+    win_off = plan.win_off
+    n_win = win_off.shape[0] - 1
+    wpo = n_win // (D * T)
+    # plan pads (slot num_slots-1, up to 2 CHUNKs of them) would all land
+    # in the LAST owner block and can overflow its buffer; clamp every
+    # span to `n_real` (the caller's real occurrence count — REQUIRED, so
+    # no caller accidentally counts pads against capacity). Stable sorting
+    # puts pads after the real occurrences of the last slot, so clamping
+    # drops only pads; each buffer pads ITSELF past its span (mask 0,
+    # slot s_local-1).
+    slots = np.full((T, D, cap), s_local - 1, np.int32)
+    row = np.zeros((T, D, cap), np.int32)
+    mask = np.zeros((T, D, cap), np.float32)
+    fields = np.zeros((T, D, cap), np.int32) if with_fields else None
+    off = np.empty((T, D, wpo + 1), np.int32)
+    for t in range(T):
+        for d in range(D):
+            o = d * T + t
+            lo = min(int(win_off[o * wpo]), n_real)
+            hi = min(int(win_off[(o + 1) * wpo]), n_real)
+            L = hi - lo
+            if L > cap:
+                raise FullshardOverflowError(
+                    f"owner block {o} holds {L} occurrences > buffer capacity "
+                    f"{cap}: the hash distribution is more skewed than "
+                    f"data.fullshard_slack={slack} allows — raise it (a hot "
+                    "feature's occurrences all land in one block)"
+                )
+            slots[t, d, :L] = plan.sorted_slots[lo:hi] - o * s_local
+            row[t, d, :L] = plan.sorted_row[lo:hi]
+            mask[t, d, :L] = plan.sorted_mask[lo:hi]
+            if with_fields:
+                fields[t, d, :L] = plan.sorted_fields[lo:hi]
+            off[t, d, :wpo] = (
+                np.minimum(win_off[o * wpo : (o + 1) * wpo], n_real) - lo
+            )
+            off[t, d, wpo] = cap
+    out = {"fs_slots": slots, "fs_row": row, "fs_mask": mask, "fs_off": off}
+    if with_fields:
+        out["fs_fields"] = fields
+    return out
+
+
+def plan_fullshard_batch(
+    slots: np.ndarray,
+    mask: np.ndarray,
+    cfg: Config,
+    mesh: Mesh,
+    fields: Optional[np.ndarray] = None,
+) -> dict:
+    """This process's [B, F] batch -> stacked fullshard buffers
+    [D_local, T, D, cap] (+ fs_off [D_local, T, D, wpo+1]).
+
+    Each local data shard is planned (slot-sorted) and sliced
+    independently; the C planner releases the GIL, so shards parallelize
+    across host cores like plan_sorted_stacked's sub-batches.
+    """
+    from xflow_tpu.ops.sorted_table import _native_planner, _plan_pool
+
+    d, t, p = _dims(cfg, mesh)
+    d_local = d // p
+    B = slots.shape[0]
+    if B != cfg.data.batch_size or slots.shape[1] != cfg.data.max_nnz:
+        # capacity is sized from the config; a mismatched batch would
+        # validate against the wrong buffer budget
+        raise ValueError(
+            f"batch shape {slots.shape} != configured "
+            f"(batch_size={cfg.data.batch_size}, max_nnz={cfg.data.max_nnz})"
+        )
+    rows = B // d_local
+    cap = fullshard_capacity(cfg, mesh)
+    s_local = cfg.num_slots // (d * t)
+    with_fields = fields is not None
+
+    def one(i):
+        sl = slice(i * rows, (i + 1) * rows)
+        plan = plan_sorted_batch(
+            slots[sl], mask[sl], cfg.num_slots,
+            fields=fields[sl] if with_fields else None,
+        )
+        return fullshard_buffers(
+            plan, d, t, cap, s_local, cfg.data.fullshard_slack, with_fields,
+            n_real=rows * slots.shape[1],
+        )
+
+    bufs = map_host_parallel(one, d_local)
+    return {k: np.stack([b[k] for b in bufs]) for k in bufs[0]}
+
+
+def fullshard_batch_sharding(mesh: Mesh, with_fields: bool = False) -> dict:
+    """Subset of the canonical batch_sharding dict (parallel/mesh.py) so
+    the placement and jit in_shardings contracts stay in lockstep."""
+    from xflow_tpu.parallel.mesh import batch_sharding
+
+    full = batch_sharding(mesh)
+    keys = FS_KEYS + (("fs_fields",) if with_fields else ()) + (
+        "labels", "row_mask",
+    )
+    return {k: full[k] for k in keys}
+
+
+def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
+    """FM/MVM train step with everything sharded over ('data','table')."""
+    validate_sorted_fullshard(cfg, mesh)
+    D, T, _ = _dims(cfg, mesh)
+    mvm = cfg.model.name == "mvm"
+    tname = "v" if mvm else "wv"
+    nf = cfg.model.num_fields
+    bf16 = cfg.data.sorted_bf16
+
+    def local_loss(tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
+                   labels, row_mask):
+        """Device (d, t) body. tbl_local [S/(D*T), K]; fs_* are MY source
+        shard's buffers for column t, [D_dst, cap]; labels [R]."""
+        K = tbl_local.shape[1]
+        R = labels.shape[0]
+
+        # 2. exchange: my buffer for dest d' -> device (d', t); receive
+        # every source's buffer for MY block. One collective, over 'data'.
+        def a2a(x):
+            return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
+
+        r_slots = a2a(fs_slots)  # [D_src, cap]
+        r_row = a2a(fs_row)
+        r_mask = a2a(fs_mask)
+        r_off = a2a(fs_off)  # [D_src, wpo+1]
+        slots_flat = r_slots.reshape(-1)
+        mask_flat = jax.lax.stop_gradient(r_mask.reshape(-1))
+
+        # 3. local windowed gather (+ shard-local scatter in the VJP)
+        occ_t = table_gather_sorted_multi(tbl_local, slots_flat, r_off, bf16)
+        occm_t = occ_t[:K] * mask_flat[None, :]
+
+        # rows arrive shard-local [0, R); globalize by source index so one
+        # segment space covers all D source shards' rows
+        grow = (r_row + jnp.arange(D, dtype=jnp.int32)[:, None] * R).reshape(-1)
+        if mvm:
+            r_fields = a2a(fs_fields)
+            seg = grow * nf + r_fields.reshape(-1)
+            # mask rides as an extra channel: its segment-sum is the
+            # per-(row, field) occurrence count => `present` (models/mvm.py)
+            stacked = jnp.concatenate([occm_t, mask_flat[None, :]], axis=0)
+            sums_t = jax.vmap(
+                lambda r: jax.ops.segment_sum(r, seg, num_segments=D * R * nf)
+            )(stacked)  # [k+1, D*R*nf]
+            partials = sums_t.reshape(K + 1, D, R * nf).transpose(1, 2, 0)
+        else:
+            from xflow_tpu.models.fm import stack_channels
+
+            stacked = stack_channels(occm_t, K)  # [ch, N]
+            rs = row_sums_sorted(stacked, grow, D * R)  # [D*R, ch]
+            partials = rs.reshape(D, R, -1)
+
+        # 4. return aggregated rows to their owners: block d' of the
+        # partial sums belongs to the devices with data-coordinate d'
+        mine = jax.lax.psum_scatter(
+            partials, DATA_AXIS, scatter_dimension=0, tiled=True
+        )  # [1, R(*nf), ch]
+        sums = jax.lax.psum(mine, TABLE_AXIS)[0]
+
+        if mvm:
+            sums = sums.reshape(R, nf, K + 1)
+            s, present = sums[..., :K], sums[..., K] > 0
+            factors = jnp.where(present[..., None], s, 1.0)
+            logits = jnp.prod(factors, axis=1).sum(axis=-1)
+        else:
+            from xflow_tpu.models.fm import fm_logits_from_sums
+
+            logits = fm_logits_from_sums(sums, K, cfg)
+        per_row = binary_logloss_from_logits(logits, labels)
+        loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
+        rows_n = jax.lax.psum(row_mask.sum(), DATA_AXIS)
+        return loss_sum / jnp.maximum(rows_n, 1.0), rows_n
+
+    fs_spec = P(DATA_AXIS, TABLE_AXIS, None, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P((DATA_AXIS, TABLE_AXIS), None),  # table shard [S/(D*T), K]
+            fs_spec, fs_spec, fs_spec, fs_spec, fs_spec,  # fs_* [1,1,D,cap]
+            P(DATA_AXIS, None),  # labels [1, R]
+            P(DATA_AXIS, None),  # row_mask
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def sharded_loss(tbl, fss, fsr, fsm, fso, fsf, labels, rm):
+        sq = lambda x: x[0, 0]
+        return local_loss(
+            tbl, sq(fss), sq(fsr), sq(fsm), sq(fso), sq(fsf), labels[0], rm[0]
+        )
+
+    def loss_for_grad(tbl, batch):
+        fsf = batch["fs_fields"] if mvm else batch["fs_slots"]  # unused for FM
+        return sharded_loss(
+            tbl,
+            batch["fs_slots"], batch["fs_row"], batch["fs_mask"],
+            batch["fs_off"], fsf,
+            batch["labels"].reshape(D, -1),
+            batch["row_mask"].reshape(D, -1),
+        )
+
+    def train_step(state: TrainState, batch: dict):
+        (loss, rows), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(
+            state.tables[tname], batch
+        )
+        new_tables, new_opt = optimizer.apply(
+            {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
+        )
+        metrics = {"loss": loss, "rows": rows}
+        return TrainState(new_tables, new_opt, state.step + 1), metrics
+
+    from xflow_tpu.parallel.mesh import state_shardings
+
+    bsh = fullshard_batch_sharding(mesh, with_fields=mvm)
+    rep = NamedSharding(mesh, P())
+    jitted = None
+
+    def call(state: TrainState, batch: dict):
+        nonlocal jitted
+        if jitted is None:
+            ssh = state_shardings(state, mesh)
+            jitted = jax.jit(
+                train_step,
+                in_shardings=(ssh, bsh),
+                out_shardings=(ssh, {"loss": rep, "rows": rep}),
+                donate_argnums=(0,),
+            )
+        return jitted(state, {k: batch[k] for k in bsh})
+
+    return call

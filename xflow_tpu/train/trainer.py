@@ -84,25 +84,52 @@ class Trainer:
         self.rank = process_index
         # sorted-window table layout (ops/sorted_table.py):
         # - single device: fused-FM and MVM (Pallas kernels / XLA fallback)
-        # - mesh: fused-FM via the sharded engine (parallel/sorted_sharded
-        #   .py — table sharded over the 'table' axis, per-data-shard
-        #   plans, one row-sum psum). Multi-process works when the data
-        #   axis divides across processes: each process plans its own
-        #   rows into D/P sub-plans (2-process subprocess-tested). Other
-        #   mesh configs keep the GSPMD row-major path.
+        # - mesh: fused-FM and MVM via one of two engines selected by
+        #   data.sorted_mesh — "fullshard" (default; table + state sharded
+        #   over the WHOLE mesh, parallel/sorted_fullshard.py) or
+        #   "replicated" (table on the 'table' axis only, D× memory,
+        #   parallel/sorted_sharded.py). Multi-process works when the data
+        #   axis divides across processes (2-process subprocess-tested for
+        #   both engines). Configs neither engine can run keep the GSPMD
+        #   row-major path.
         from xflow_tpu.ops.sorted_table import WINDOW, resolve_sub_batches
 
         sl = cfg.data.sorted_layout
+        # mesh sorted engine: None (GSPMD row-major) | "fullshard"
+        # (parallel/sorted_fullshard.py — table + state sharded over the
+        # WHOLE mesh, no replication; the 1B-feature-regime fast path) |
+        # "replicated" (parallel/sorted_sharded.py — 'table'-axis-only
+        # sharding, D× table memory, fewer collectives)
+        self._mesh_engine = None
         if mesh is not None:
-            # mesh: the sharded engine replicates the table across the
-            # 'data' axis (D× memory — parallel/sorted_sharded.py
-            # docstring), so it is OPT-IN only: 'auto' keeps the fully-
-            # sharded GSPMD path that the 1B-feature regime needs
-            self._sorted = sl == "on"
-            if self._sorted:
-                from xflow_tpu.parallel.sorted_sharded import validate_sorted_sharded
+            engine = cfg.data.sorted_mesh
+            if engine not in ("fullshard", "replicated"):
+                raise ValueError(
+                    f"data.sorted_mesh={engine!r}: expected 'fullshard' or "
+                    "'replicated'"
+                )
+            from xflow_tpu.parallel.sorted_fullshard import validate_sorted_fullshard
+            from xflow_tpu.parallel.sorted_sharded import validate_sorted_sharded
 
-                validate_sorted_sharded(cfg, mesh)  # specific diagnostics
+            if sl == "on":
+                # forced: reject unrunnable configs with the specific reason
+                if engine == "fullshard":
+                    validate_sorted_fullshard(cfg, mesh)
+                else:
+                    validate_sorted_sharded(cfg, mesh)
+                self._mesh_engine = engine
+            elif sl == "auto" and engine == "fullshard":
+                # auto enables the fully-sharded engine whenever the config
+                # can run it (it IS the fast path for FM/MVM, with the same
+                # no-replication memory story as GSPMD); the replicated
+                # engine stays opt-in only — its D× table memory must be an
+                # explicit choice
+                try:
+                    validate_sorted_fullshard(cfg, mesh)
+                    self._mesh_engine = "fullshard"
+                except ValueError:
+                    self._mesh_engine = None
+            self._sorted = self._mesh_engine is not None
         else:
             supported = (
                 cfg.model.name == "fm" and cfg.model.fm_fused
@@ -134,7 +161,37 @@ class Trainer:
         if mesh is not None:
             from xflow_tpu.parallel.train_step import make_sharded_train_step, make_sharded_eval_step, shard_state
 
-            if self._sorted_sharded:
+            if self._mesh_engine == "fullshard":
+                from xflow_tpu.parallel.sorted_fullshard import (
+                    make_fullshard_train_step,
+                )
+
+                # shard_state's default layout IS the fullshard layout:
+                # every table/opt leaf P(('data','table')) on the slot axis
+                self.state = shard_state(
+                    init_state(self.model, self.optimizer, cfg), mesh
+                )
+                fullshard_step = make_fullshard_train_step(
+                    self.optimizer, cfg, mesh
+                )
+                # per-batch dispatch: a batch too skewed for the buffer
+                # capacity arrives as row-major arrays (single-process
+                # overflow fallback in _batch_arrays) and runs the GSPMD
+                # step — the state sharding is identical, so the two
+                # steps interleave freely
+                gspmd = {}
+
+                def _dispatch(state, batch):
+                    if "fs_slots" in batch:
+                        return fullshard_step(state, batch)
+                    if "step" not in gspmd:
+                        gspmd["step"] = make_sharded_train_step(
+                            self.model, self.optimizer, cfg, mesh
+                        )
+                    return gspmd["step"](state, batch)
+
+                self.train_step = _dispatch
+            elif self._mesh_engine == "replicated":
                 from xflow_tpu.parallel.sorted_sharded import (
                     make_sorted_sharded_train_step,
                     shard_sorted_state,
@@ -165,6 +222,7 @@ class Trainer:
             # high-latency links (tunneled devices: ~9 arrays × RTT/step)
             self._shard_batch = jax.device_put
         self.metrics = MetricsLogger(cfg.train.metrics_path)
+        self._fullshard_overflow_warned = False
         # MVM keys its views on the field id: a field >= num_fields would be
         # silently dropped by the one-hot, so reject it loudly
         self._validate_fields = cfg.model.name == "mvm"
@@ -189,6 +247,42 @@ class Trainer:
         too; mesh eval passes `with_plan=False` and keeps row-major.)
         """
         arrays = batch_to_arrays(batch)
+        if self._sorted and with_plan and self._mesh_engine == "fullshard":
+            from xflow_tpu.parallel.sorted_fullshard import (
+                FullshardOverflowError,
+                plan_fullshard_batch,
+            )
+
+            mvm = self.cfg.model.name == "mvm"
+            try:
+                out = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
+                out.update(
+                    plan_fullshard_batch(
+                        np.asarray(batch.slots),
+                        np.asarray(batch.mask),
+                        self.cfg,
+                        self.mesh,
+                        fields=np.asarray(batch.fields) if mvm else None,
+                    )
+                )
+                return out
+            except FullshardOverflowError:
+                if jax.process_count() > 1:
+                    # a silent per-process fallback would desync the
+                    # collective programs across ranks and deadlock; the
+                    # planner's error carries the slack advice
+                    raise
+                if not self._fullshard_overflow_warned:
+                    self._fullshard_overflow_warned = True
+                    print(
+                        "fullshard: batch too skewed for "
+                        f"data.fullshard_slack={self.cfg.data.fullshard_slack}; "
+                        "falling back to the GSPMD row-major step for such "
+                        "batches (raise the slack to keep the fast path)",
+                        file=sys.stderr,
+                    )
+                    self.metrics.log({"fullshard_overflow_fallback": True})
+                return arrays  # row-major: the GSPMD step handles it
         if self._sorted and with_plan:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
