@@ -257,6 +257,25 @@ def main() -> int:
             rates[dist] = K * B / best
         return rates
 
+    kernel_parity = None
+    if jax.default_backend() == "tpu" and not args.no_sorted:
+        # on-device parity gate (VERDICT r2 item 6): the sorted-window
+        # kernels are only lowered through Mosaic on a real chip, so the
+        # silent-rounding class of bug is only visible here — fail the
+        # bench loudly rather than record a fast-but-wrong number
+        from xflow_tpu.tools.kernel_parity import check_kernel_parity
+
+        par = check_kernel_parity()
+        print(f"# kernel_parity: {par}", file=sys.stderr)
+        if not par["ok"]:
+            # fail loudly INSTEAD of recording a fast-but-wrong number:
+            # no throughput line, nonzero exit
+            print(json.dumps({"metric": "kernel_parity", "value": 0,
+                              "unit": "bool", "vs_baseline": 0,
+                              "error": f"kernel parity FAILED: {par['checks']}"}))
+            return 1
+        kernel_parity = "ok"
+
     models = ["lr", "fm", "mvm"] if args.model == "all" else [args.model]
     # skewed-slot (Zipf alpha=1.05) runs ride along (round-1 verdict item
     # 9): real CTR id streams are heavy-tailed, and uniform slots are the
@@ -279,6 +298,8 @@ def main() -> int:
     for name in models:
         if "zipf" in rates[name]:
             record[f"zipf_{name}_examples_per_sec"] = round(rates[name]["zipf"], 1)
+    if kernel_parity is not None:
+        record["kernel_parity"] = kernel_parity
     print(json.dumps(record))
     return 0
 

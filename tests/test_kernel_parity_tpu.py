@@ -1,0 +1,26 @@
+"""On-TPU kernel parity gate (VERDICT r2 item 6).
+
+Auto-skips off-TPU: the pytest conftest pins an 8-device CPU platform,
+so in CI this file is a no-op; on a TPU host run
+
+    XFLOW_TEST_PLATFORM=tpu python -m pytest tests/test_kernel_parity_tpu.py
+
+`bench.py` also runs the same check on every benchmark invocation (the
+driver always benches on real hardware), so `BENCH_r*.json` carries a
+`kernel_parity` field — the silent-MXU-rounding class of bug
+(docs/CHANGES_R2.md "Precision integrity") cannot regress unseen.
+"""
+
+import jax
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="requires a real TPU chip"
+)
+
+
+def test_kernel_parity_on_device():
+    from xflow_tpu.tools.kernel_parity import check_kernel_parity
+
+    res = check_kernel_parity()
+    assert res["ok"], res

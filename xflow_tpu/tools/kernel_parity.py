@@ -1,0 +1,194 @@
+"""On-device parity gate for the sorted-window Pallas kernels.
+
+Round 2's silent-MXU-bf16 bug (docs/CHANGES_R2.md "Precision
+integrity") is the class of regression CPU / interpret-mode tests are
+structurally blind to: the kernels are only *lowered through Mosaic* on
+a real chip, and the MXU's default operand rounding only exists there.
+This module re-checks, on whatever backend is live:
+
+- `table_gather_sorted` (single-stream and multi-buffer) is BIT-exact
+  against the XLA gather oracle — the 3-term bf16 decomposition's
+  selection property (`_dot_f32`), not a tolerance;
+- the windowed scatter VJPs match `jax.ops.segment_sum` within the
+  reduction-reorder class (≤ ~1 ulp per accumulated term);
+- `row_sums_sorted`'s scalar-core RMW matches segment_sum likewise;
+- the opt-in bf16 fast mode is *approximately* right (2^-7 rel) — it
+  must stay a rounding trade, never a wrong-window bug.
+
+Run by `bench.py` on the real chip (BENCH_r*.json carries a
+`kernel_parity` field) and by `tests/test_kernel_parity_tpu.py`, which
+auto-skips off-TPU (the pytest conftest pins CPU; set
+`XFLOW_TEST_PLATFORM=tpu` on a TPU host to include it).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _rel_err(a: np.ndarray, b: np.ndarray, floor: float = 1e-30) -> float:
+    """Max ELEMENTWISE relative error: with the table's deliberately huge
+    dynamic range, a global-max denominator would hide wrong values on
+    small-magnitude entries entirely. `floor` is the absolute scale
+    below which differences count as absolute, not relative — reduction
+    checks need it because a slot whose unit-scale terms cancel to ~0
+    has unbounded *relative* reorder noise while a wrong-routing bug
+    still moves O(1) mass (err >= ~1 >> any tolerance here)."""
+    return float(np.max(np.abs(a - b) / (np.abs(b) + floor)))
+
+
+def check_kernel_parity(
+    log2_slots: int = 15,
+    n_occ: int = 1 << 17,
+    k: int = 11,
+    batch: int = 4096,
+    seed: int = 0,
+) -> dict:
+    """Returns {"ok": bool, "checks": {name: max_rel_err}, "backend": str}.
+
+    Gather checks require rel err == 0.0 (bit-exact); scatter/rowsum
+    allow 1e-4 over a 1e-2 floor (f32 reduction reorder on unit-scale
+    terms); bf16 mode allows 2^-7.
+    """
+    from xflow_tpu.ops.sorted_table import (
+        _gather_xla,
+        _k8,
+        CHUNK,
+        WINDOW,
+        plan_sorted_batch,
+        row_sums_sorted,
+        table_gather_sorted,
+        table_gather_sorted_multi,
+    )
+
+    rng = np.random.default_rng(seed)
+    S = 1 << log2_slots
+    nnz = n_occ // batch
+    slots = rng.integers(0, S, (batch, nnz)).astype(np.int32)
+    mask = (rng.random((batch, nnz)) < 0.9).astype(np.float32)
+    table = rng.standard_normal((S, k)).astype(np.float32)
+    # exercise the full f32 mantissa: values whose hi/mid/lo bf16 terms
+    # are all nonzero, plus denormal-adjacent magnitudes
+    table *= np.exp(rng.uniform(-8, 8, (S, 1))).astype(np.float32)
+    plan = plan_sorted_batch(slots, mask, S)
+    Np = plan.sorted_slots.shape[0]
+    checks: dict[str, float] = {}
+
+    tbl = jnp.asarray(table)
+    ss = jnp.asarray(plan.sorted_slots)
+    wo = jnp.asarray(plan.win_off)
+
+    # --- gather: bit-exact vs the XLA oracle on the same device
+    got = np.asarray(jax.jit(lambda t, s, w: table_gather_sorted(t, s, w, False))(tbl, ss, wo))
+    want = np.asarray(jax.jit(_gather_xla)(tbl, ss, wo))
+    checks["gather_exact"] = _rel_err(got, want)
+
+    # --- gather, bf16 opt-in: a rounding trade, not a routing bug
+    got16 = np.asarray(jax.jit(lambda t, s, w: table_gather_sorted(t, s, w, True))(tbl, ss, wo))
+    checks["gather_bf16"] = _rel_err(got16, want)
+
+    # --- scatter (the gather VJP): reduction-reorder class vs segment_sum
+    d_occ = rng.standard_normal((_k8(k), Np)).astype(np.float32)
+    d_occ *= np.asarray(plan.sorted_mask)[None, :]
+
+    def scat(t, s, w, d):
+        _, vjp = jax.vjp(lambda tt: table_gather_sorted(tt, s, w, False), t)
+        return vjp(d)[0]
+
+    got_s = np.asarray(jax.jit(scat)(tbl, ss, wo, jnp.asarray(d_occ)))
+    want_s = np.asarray(
+        jax.jit(
+            lambda d, s: jax.ops.segment_sum(d[:k].T, s, num_segments=S)
+        )(jnp.asarray(d_occ), ss)
+    )
+    checks["scatter_exact"] = _rel_err(got_s, want_s, floor=1e-2)
+
+    # --- multi-buffer gather/scatter (fullshard engine): split the
+    # sorted stream in two, pad each buffer to a fixed capacity with
+    # slot S-1 per the host contract (each half of a sorted stream is
+    # itself sorted, so no re-sort is needed)
+    cap = ((Np // 2) // CHUNK + 1) * CHUNK
+    bufs, offs = [], []
+    split = (Np // 2 // CHUNK) * CHUNK
+    for part in (np.asarray(plan.sorted_slots)[:split],
+                 np.asarray(plan.sorted_slots)[split:]):
+        pad = np.full(cap - part.size, S - 1, np.int32)
+        buf = np.concatenate([part.astype(np.int32), pad])
+        off = np.searchsorted(buf, np.arange(0, S + 1, WINDOW)).astype(np.int32)
+        off[-1] = cap  # pads ride in the last window
+        bufs.append(buf)
+        offs.append(off)
+    mslots = jnp.asarray(np.concatenate(bufs))
+    moff = jnp.asarray(np.stack(offs))
+    got_m = np.asarray(
+        jax.jit(lambda t, s, o: table_gather_sorted_multi(t, s, o, False))(tbl, mslots, moff)
+    )
+    want_m = np.asarray(jax.jit(_gather_xla)(tbl, mslots, jnp.zeros((1,), jnp.int32)))
+    checks["gather_multi_exact"] = _rel_err(got_m, want_m)
+
+    d_m = rng.standard_normal(got_m.shape).astype(np.float32)
+
+    def scat_m(t, s, o, d):
+        _, vjp = jax.vjp(lambda tt: table_gather_sorted_multi(tt, s, o, False), t)
+        return vjp(d)[0]
+
+    got_ms = np.asarray(jax.jit(scat_m)(tbl, mslots, moff, jnp.asarray(d_m)))
+    want_ms = np.asarray(
+        jax.jit(
+            lambda d, s: jax.ops.segment_sum(d[:k].T, s, num_segments=S)
+        )(jnp.asarray(d_m), mslots)
+    )
+    checks["scatter_multi_exact"] = _rel_err(got_ms, want_ms, floor=1e-2)
+
+    # --- row-sum kernel (the FM forward's occurrence->row reduction)
+    ch = 24
+    vals_t = (rng.standard_normal((ch, Np)).astype(np.float32)
+              * np.asarray(plan.sorted_mask)[None, :])
+    rows = jnp.asarray(plan.sorted_row)
+    got_r = np.asarray(
+        jax.jit(lambda v, r: row_sums_sorted(v, r, batch))(jnp.asarray(vals_t), rows)
+    )
+    want_r = np.asarray(
+        jax.jit(lambda v, r: jax.ops.segment_sum(v.T, r, num_segments=batch))(
+            jnp.asarray(vals_t), rows
+        )
+    )
+    checks["rowsum"] = _rel_err(got_r, want_r, floor=1e-2)
+
+    tol = {
+        "gather_exact": 0.0,
+        "gather_multi_exact": 0.0,
+        "gather_bf16": 2.0 ** -7,
+        # scatters sum duplicate-slot terms in kernel order, segment_sum
+        # in its own — absolute reorder noise is ~1e-6 on unit-scale
+        # terms (measured on-device); with the 1e-2 floor that reads as
+        # <=1e-4, while a routing bug moves O(1) mass (err >= ~1)
+        "scatter_exact": 1e-4,
+        "scatter_multi_exact": 1e-4,
+        "rowsum": 1e-4,
+    }
+    ok = all(checks[name] <= tol[name] for name in tol)
+    return {"ok": ok, "checks": checks, "backend": jax.default_backend()}
+
+
+def main() -> int:
+    import json
+    import sys
+
+    res = check_kernel_parity()
+    if res["backend"] != "tpu":
+        # every check would trivially compare the XLA path against
+        # itself — "ok" here would be a false all-clear
+        print(f"kernel_parity: backend is {res['backend']}, not tpu — "
+              "the Pallas kernels were never executed", file=sys.stderr)
+        print(json.dumps({**res, "ok": False, "error": "not on tpu"}))
+        return 2
+    print(json.dumps(res))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
