@@ -155,24 +155,44 @@ def main() -> int:
 
     zipf_slots_cache = {}
 
-    def bench_model(name: str, dists) -> dict:
+    def bench_model(name: str, dists, dup_fields: bool = False) -> dict:
         """Compile the model's K-step program ONCE, then time each slot
-        distribution on it (shapes identical → no recompile)."""
-        cfg = override(
-            Config(),
-            **{
-                "model.name": name,
-                "data.log2_slots": args.log2_slots,
-                "data.max_nnz": args.nnz,
-                "data.batch_size": args.batch,
-                "data.sorted_sub_batches": args.sub_batches,
-                "data.sorted_bf16": args.sorted_bf16,
-            },
-        )
+        distribution on it (shapes identical → no recompile).
+
+        MVM benches its NATURAL data shape by default: one feature per
+        field (fields 0..nnz-1 — what libffm FFM rows are), which the
+        exclusive-fields product path (models/mvm.py) requires; per-row
+        occurrence count matches the other models exactly.
+        `dup_fields=True` instead draws random fields over num_fields=18
+        (every row has duplicate fields), exercising the general
+        segment-sum path — recorded as the `mvm_dupfields_*` companion.
+        """
+        overrides = {
+            "model.name": name,
+            "data.log2_slots": args.log2_slots,
+            "data.max_nnz": args.nnz,
+            "data.batch_size": args.batch,
+            "data.sorted_sub_batches": args.sub_batches,
+            "data.sorted_bf16": args.sorted_bf16,
+        }
+        if name == "mvm":
+            if dup_fields:
+                overrides["model.mvm_exclusive"] = "off"
+            else:
+                overrides["model.num_fields"] = args.nnz
+                overrides["model.mvm_exclusive"] = "on"
+        cfg = override(Config(), **overrides)
         model, opt = get_model(name), get_optimizer("ftrl")
         step = make_train_step(model, opt, cfg, jit=False)
         mask_np = (rng.random((K, B, F)) < 0.6).astype(np.float32)
-        fields_host = rng.integers(0, cfg.model.num_fields, (K, B, F)).astype(np.int32)
+        if name == "mvm" and not dup_fields:
+            fields_host = np.broadcast_to(
+                np.arange(F, dtype=np.int32), (K, B, F)
+            ).copy()
+        else:
+            fields_host = rng.integers(
+                0, cfg.model.num_fields, (K, B, F)
+            ).astype(np.int32)
         common = {
             "fields": jnp.asarray(fields_host),
             "mask": jnp.asarray(mask_np),
@@ -198,21 +218,28 @@ def main() -> int:
                 )
 
                 ns = resolve_sub_batches(cfg)
-                fields_np = fields_host if name == "mvm" else None
+                # only the MVM segment path consumes per-occurrence fields;
+                # the product path routes on their absence (models/mvm.py)
+                use_fields = name == "mvm" and dup_fields
                 plans = [
                     plan_sorted_stacked(
                         slots_np[i], mask_np[i], cfg.num_slots,
-                        fields=None if fields_np is None else fields_np[i],
+                        fields=fields_host[i] if use_fields else None,
                         num_sub=ns,
                     )
                     for i in range(K)
                 ]
-                print(f"# {name}: sorted layout, sub_batches={ns}", file=sys.stderr)
+                path = (
+                    f"sorted layout ({'segment' if use_fields else 'product'})"
+                    if name == "mvm"
+                    else "sorted layout"
+                )
+                print(f"# {name}: {path}, sub_batches={ns}", file=sys.stderr)
                 batches["sorted_slots"] = jnp.asarray(np.stack([p.sorted_slots for p in plans]))
                 batches["sorted_row"] = jnp.asarray(np.stack([p.sorted_row for p in plans]))
                 batches["sorted_mask"] = jnp.asarray(np.stack([p.sorted_mask for p in plans]))
                 batches["win_off"] = jnp.asarray(np.stack([p.win_off for p in plans]))
-                if name == "mvm":
+                if use_fields:
                     batches["sorted_fields"] = jnp.asarray(
                         np.stack([p.sorted_fields for p in plans])
                     )
@@ -298,6 +325,14 @@ def main() -> int:
     for name in models:
         if "zipf" in rates[name]:
             record[f"zipf_{name}_examples_per_sec"] = round(rates[name]["zipf"], 1)
+    if "mvm" in models and not args.no_sorted:
+        # general-path companion: random fields over 18 field groups =
+        # every row has multi-valued fields, so the segment-sum path runs
+        dup = bench_model("mvm", ("uniform",), dup_fields=True)
+        record["mvm_dupfields_examples_per_sec"] = round(dup["uniform"], 1)
+        record["mvm_dupfields_vs_baseline"] = round(
+            dup["uniform"] / PER_CHIP_TARGET, 3
+        )
     if kernel_parity is not None:
         record["kernel_parity"] = kernel_parity
     print(json.dumps(record))

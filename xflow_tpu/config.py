@@ -74,6 +74,18 @@ class ModelConfig:
     name: str = "lr"  # "lr" | "fm" | "mvm"
     v_dim: int = 10
     num_fields: int = 18
+    # MVM exclusive-fields product path (models/mvm.py): when every
+    # masked (row, field) has at most one occurrence — the natural
+    # libffm shape — the field product collapses to a product over the
+    # row's occurrences, computed through the same cache-resident
+    # [B, ~24] row-sum kernel FM uses instead of the [B·nf, k+1]
+    # segment aggregate (the measured MVM wall, docs/PERF.md 3a).
+    # "auto": check each batch on the host; route duplicate-field
+    # batches to the segment path (single-process) or raise
+    # (multi-process — per-batch routing would desync the ranks'
+    # collective programs). "on": require exclusive fields (raise on
+    # duplicates). "off": always the general segment path.
+    mvm_exclusive: str = "auto"
     fm_standard: bool = True
     fm_half: bool = True
     # fused [S, 1+k] w+v table (one gather+scatter pass instead of two;

@@ -28,12 +28,175 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from xflow_tpu.models.base import Model, register_model
+
+# Exclusive-fields product path constants (see mvm_product_channels):
+# LOG_TINY guards ln(0) — EXACT zeros are tracked separately in the Z
+# channel, and because every formula uses ln-sums DIFFERENCES (S, or the
+# exclusive S - L_j), the clamped value cancels wherever it matters.
+# The S clip bounds exp: products past e^60 are a diverged model (logits
+# saturate the ±30 reference sigmoid clamp long before), and below e^-87
+# f32 underflows to the 0 the true product rounds to anyway.
+MVM_LOG_TINY = 1e-30
+MVM_LOG_CLIP = (-87.0, 60.0)
 
 
 def _table_specs(cfg):
     return {"v": (cfg.model.v_dim,)}
+
+
+def has_field_duplicates(fields: np.ndarray, mask: np.ndarray) -> bool:
+    """Host-side check: does any row carry two masked occurrences of the
+    same field? The exclusive-fields product path requires it false (the
+    per-(row, field) view sum then has at most one term, so the product
+    over fields equals the product over the row's occurrences). Real
+    libffm CTR data is one-feature-per-field by construction; multi-
+    valued fields route to the segment-sum path instead.
+
+    Bitmask popcount when field ids fit 64 bits (~3 vector passes), else
+    a per-row sort."""
+    f = np.asarray(fields)
+    m = np.asarray(mask) > 0
+    if f.size == 0 or f.shape[1] <= 1:
+        return False
+    if int(f.max(initial=0)) < 64 and hasattr(np, "bitwise_count"):
+        # np.bitwise_count is NumPy >= 2.0; older NumPy (still JAX-
+        # supported) takes the sort path below
+        bits = np.where(m, np.uint64(1) << f.astype(np.uint64), np.uint64(0))
+        distinct = np.bitwise_count(np.bitwise_or.reduce(bits, axis=1))
+        return bool((distinct.astype(np.int64) < m.sum(axis=1)).any())
+    # wide field spaces: masked-out entries get distinct negative keys so
+    # they can never form an adjacent equal pair
+    keyed = np.where(m, f.astype(np.int64), -1 - np.arange(f.shape[1])[None, :])
+    s = np.sort(keyed, axis=1)
+    return bool(((s[:, 1:] == s[:, :-1]) & (s[:, 1:] >= 0)).any())
+
+
+def resolve_mvm_product(mvm_exclusive: str, has_dup: bool, num_processes: int) -> bool:
+    """Route one batch: product path (True) or segment-sum path (False).
+
+    Multi-process runs cannot route per batch — the two paths have
+    different collective sequences, and ranks see different rows, so a
+    data-dependent choice would desync the SPMD programs. There (and
+    under `mvm_exclusive=on`) duplicate fields raise instead.
+    """
+    if mvm_exclusive == "off":
+        return False
+    if mvm_exclusive not in ("auto", "on"):
+        raise ValueError(
+            f"model.mvm_exclusive={mvm_exclusive!r}: expected auto|on|off"
+        )
+    if has_dup:
+        if mvm_exclusive == "on" or num_processes > 1:
+            raise ValueError(
+                "MVM exclusive-fields product path: a row carries two masked "
+                "occurrences of the same field. Set model.mvm_exclusive=off "
+                "to use the segment-sum path"
+                + (
+                    " (multi-process runs cannot fall back per batch: the two "
+                    "paths' collective sequences differ across ranks. This "
+                    "check sees only THIS rank's rows, so peer ranks that hit "
+                    "no duplicate will sit in their collective until the job "
+                    "timeout — pre-validate multi-valued-field data, or set "
+                    "mvm_exclusive=off up front)"
+                    if num_processes > 1
+                    else ""
+                )
+            )
+        return False
+    return True
+
+
+def mvm_product_channels(occ_t_k, sorted_mask, k: int):
+    """[k, Np] RAW gathered v rows + [Np] mask -> [ch, Np] channels whose
+    row sums carry the per-row factor products in log space.
+
+    With exclusive fields, Π_f s[c,r,f] = Π_{occ∈r} v_c[occ] (absent
+    fields are the multiplicative identity; masked pads contribute 0 to
+    every channel). Channels per latent dim: ln|v| (zeros clamped to
+    ln(LOG_TINY) — the Z channel is the truth about zeros, and every
+    consumer uses ln-sum DIFFERENCES so the clamp cancels), negative
+    count (sign parity), exact-zero count; zero-padded to a sublane
+    multiple. The row state is a cache-resident [B, ~32] array — the
+    same class as FM's, replacing the [B·nf, k+1] segment aggregate that
+    was the MVM step's measured wall (docs/PERF.md 3a)."""
+    from xflow_tpu.ops.sorted_table import _k8
+
+    m = sorted_mask[None, :]
+    L = m * jnp.log(jnp.maximum(jnp.abs(occ_t_k), MVM_LOG_TINY))
+    N = m * (occ_t_k < 0.0)
+    Z = m * (occ_t_k == 0.0)
+    ch = _k8(3 * k)
+    pad = jnp.zeros((ch - 3 * k, occ_t_k.shape[1]), occ_t_k.dtype)
+    return jnp.concatenate([L, N, Z, pad], axis=0)
+
+
+def _products_from_sums(S, NC, ZC):
+    """(ln-sum, negative count, zero count) -> signed products. Counts
+    are integer-valued floats ≤ max_nnz, exact in f32."""
+    sign = 1.0 - 2.0 * jnp.mod(NC, 2.0)
+    return jnp.where(ZC > 0, 0.0, sign * jnp.exp(jnp.clip(S, *MVM_LOG_CLIP)))
+
+
+def make_row_products(reduce_rows, broadcast_rows, k: int):
+    """Build the exclusive-fields product op:
+
+        op(occ_t_k [k, Np], mask [Np], rows [Np]) -> P [R, k]
+
+    with P[r, c] = Π over r's masked occurrences of v_c — computed in
+    log space through `reduce_rows` (the occurrence→row reduction:
+    `row_sums_sorted` on one device; rowsum + psum_scatter + psum in the
+    fullshard engine) — and a HAND-WRITTEN VJP that is exact at FTRL's
+    exact zeros in both directions:
+
+      dP/dv_j = (exclusive product of the row's OTHER factors)
+              = sign_ex · exp(S - L_j) · [ZC - Z_j == 0]
+
+    A zero occurrence keeps its nonzero reactivation gradient (the
+    clamped ln cancels in S - L_j), and the other occurrences of a
+    zero-containing row get EXACTLY zero — matching the oracle bitwise
+    in the zero pattern, which FTRL's lazy-init parity guard (g==0 ∧
+    n==0 keeps the initial weight) depends on; an epsilon-perturbation
+    scheme instead leaves ~1e-34 gradient residues that mark untouched
+    slots as touched. `broadcast_rows` is the bwd's row-aggregate
+    transport (identity on one device; all_gather over 'data' in the
+    fullshard engine — the same small-row-cotangent traffic class as
+    FM's backward).
+    """
+    @jax.custom_vjp
+    def op(occ_t_k, mask, rows):
+        P, _ = _fwd(occ_t_k, mask, rows)
+        return P
+
+    def _fwd(occ_t_k, mask, rows):
+        sums = reduce_rows(mvm_product_channels(occ_t_k, mask, k), rows)
+        S, NC, ZC = sums[:, :k], sums[:, k : 2 * k], sums[:, 2 * k : 3 * k]
+        P = _products_from_sums(S, NC, ZC)
+        return P, (occ_t_k, mask, rows, sums)
+
+    def _bwd(res, dP):
+        occ_t_k, mask, rows, sums = res
+        per = jnp.take(
+            broadcast_rows(jnp.concatenate([dP, sums[:, : 3 * k]], axis=1)),
+            rows,
+            axis=0,
+        ).T  # [4k, Np]
+        dPo, S, NC, ZC = (per[i * k : (i + 1) * k] for i in range(4))
+        m = mask[None, :]
+        L = jnp.log(jnp.maximum(jnp.abs(occ_t_k), MVM_LOG_TINY))
+        S_ex = S - m * L
+        NC_ex = NC - m * (occ_t_k < 0.0)
+        ZC_ex = ZC - m * (occ_t_k == 0.0)
+        sign_ex = 1.0 - 2.0 * jnp.mod(NC_ex, 2.0)
+        P_ex = jnp.where(
+            ZC_ex > 0, 0.0, sign_ex * jnp.exp(jnp.clip(S_ex, *MVM_LOG_CLIP))
+        )
+        return dPo * P_ex * m, None, None
+
+    op.defvjp(lambda o, m_, r: _fwd(o, m_, r), _bwd)
+    return op
 
 
 def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
@@ -58,25 +221,55 @@ def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
     return jnp.prod(factors, axis=-1).sum(axis=0)  # [rows]
 
 
-def _forward_sorted(tables, batch, cfg):
-    """Sorted-window path (ops/sorted_table.py): the v-table gather and
-    its gradient scatter stream slot windows through the Pallas one-hot
-    MXU kernels; the per-(row, field) view sums become one segment-sum
-    keyed on `row * num_fields + field`.
+def _forward_sorted_product_one(v, sorted_slots, sorted_row, sorted_mask,
+                                win_off, rows, bf16=False):
+    """One sub-batch on the exclusive-fields product path: windowed
+    gather + the SAME [rows, ~32] row-sum kernel FM uses — no
+    per-(row, field) segment space exists at all."""
+    from xflow_tpu.ops.sorted_table import row_sums_sorted, table_gather_sorted
 
-    MVM's row-side aggregate is [B·nf, k] — ~47 MB at B=64k — which
-    falls out of cache residency and makes the segment-sum/its backward
-    gather ~8× slower per element (docs/PERF.md). Sorted arrays may
-    therefore arrive STACKED [NS, Np_sub] (`plan_sorted_stacked`): the
-    forward maps over row-contiguous sub-batches whose [B/NS·nf, k]
-    aggregates stay resident, and XLA accumulates the table cotangent
-    across the map. Semantics are identical to NS=1 (row order is
-    preserved; the loss/optimizer still see one batch)."""
+    k = v.shape[1]
+    occ_t = table_gather_sorted(v, sorted_slots, win_off, bf16)  # [K8, Np]
+    op = make_row_products(
+        lambda stacked, rows_: row_sums_sorted(stacked, rows_, rows),
+        lambda arr: arr,
+        k,
+    )
+    P = op(occ_t[:k], sorted_mask, sorted_row)  # [rows, k]
+    return P.sum(axis=1)
+
+
+def _forward_sorted(tables, batch, cfg):
+    """Sorted-window path (ops/sorted_table.py), two row-side forms:
+
+    - PRODUCT (no `sorted_fields` in the batch): the host verified every
+      masked (row, field) has at most one occurrence (the natural libffm
+      shape; `has_field_duplicates`), so each view sum is a single v and
+      the field product collapses to a product over the row's
+      occurrences — computed in log space through `row_sums_sorted`'s
+      cache-resident [B, ~24] accumulator, exactly like FM.
+    - SEGMENT (`sorted_fields` present): general multi-valued fields via
+      one segment-sum keyed on `row * num_fields + field`. Its
+      [B·nf, k+1] aggregate falls out of cache at B=64k (the backward
+      gather was the measured MVM wall, docs/PERF.md 3a), so sorted
+      arrays may arrive STACKED [NS, Np_sub] (`plan_sorted_stacked`) and
+      the forward maps over row-contiguous sub-batches; XLA accumulates
+      the table cotangent across the map. NS-invariant math either way.
+    """
     from xflow_tpu.ops.sorted_table import map_sub_batches
 
     v = tables["v"]
-    nf = cfg.model.num_fields
     bf16 = cfg.data.sorted_bf16
+    if "sorted_fields" not in batch:
+        return map_sub_batches(
+            lambda ss, sr, sm, wo, rows: _forward_sorted_product_one(
+                v, ss, sr, sm, wo, rows, bf16
+            ),
+            batch,
+            ("sorted_slots", "sorted_row", "sorted_mask", "win_off"),
+            batch["labels"].shape[0],
+        )
+    nf = cfg.model.num_fields
     return map_sub_batches(
         lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(
             v, ss, sr, sm, sf, wo, rows, nf, bf16

@@ -286,9 +286,13 @@ def auto_sub_batches(batch_size: int, row_state_bytes_per_row: int,
 def resolve_sub_batches(cfg) -> int:
     """NS for the sorted layout (cfg.data.sorted_sub_batches; 0 = auto).
 
-    Auto keeps MVM's per-sub-batch [B/NS·nf, k+1] row aggregate under
-    16 MiB (the measured v5e sweet spot — docs/PERF.md); FM's [B, 21] is
-    already small, so NS=1.
+    Auto keeps MVM's *segment-path* per-sub-batch [B/NS·nf, k+1] row
+    aggregate under 16 MiB (the measured v5e sweet spot — docs/PERF.md).
+    FM's [B, 24] is already small, so NS=1 — and so is the MVM
+    exclusive-fields product path's (models/mvm.py), which is the
+    expected path whenever `model.mvm_exclusive` != off; a stray
+    duplicate-field batch then runs the segment path at NS=1 (correct,
+    just not cache-tuned — routing NS per batch would retrace the step).
     """
     ns = cfg.data.sorted_sub_batches
     B = cfg.data.batch_size
@@ -298,7 +302,7 @@ def resolve_sub_batches(cfg) -> int:
                 f"data.sorted_sub_batches={ns} must divide batch_size={B}"
             )
         return ns
-    if cfg.model.name == "mvm":
+    if cfg.model.name == "mvm" and cfg.model.mvm_exclusive == "off":
         per_row = cfg.model.num_fields * (cfg.model.v_dim + 1) * 4
         return auto_sub_batches(B, per_row)
     return 1

@@ -261,7 +261,17 @@ def fullshard_batch_sharding(mesh: Mesh, with_fields: bool = False) -> dict:
 
 
 def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
-    """FM/MVM train step with everything sharded over ('data','table')."""
+    """FM/MVM train step with everything sharded over ('data','table').
+
+    MVM runs in one of two row-side modes, chosen PER BATCH by the
+    planner (trainer._mvm_wants_fields): "mvm_product" (no fs_fields —
+    exclusive fields verified on the host; the row side is the same
+    [R, ~24] row-sum + psum_scatter as FM, models/mvm.py) or
+    "mvm_segment" (general multi-valued fields through the [R·nf]
+    segment space). Each mode is its own jitted program; multi-process
+    runs pin one mode for the whole run (resolve_mvm_product) so the
+    ranks' collective sequences always agree.
+    """
     validate_sorted_fullshard(cfg, mesh)
     D, T, _ = _dims(cfg, mesh)
     mvm = cfg.model.name == "mvm"
@@ -269,7 +279,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
     nf = cfg.model.num_fields
     bf16 = cfg.data.sorted_bf16
 
-    def local_loss(tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
+    def local_loss(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
                    labels, row_mask):
         """Device (d, t) body. tbl_local [S/(D*T), K]; fs_* are MY source
         shard's buffers for column t, [D_dst, cap]; labels [R]."""
@@ -295,7 +305,16 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
         # rows arrive shard-local [0, R); globalize by source index so one
         # segment space covers all D source shards' rows
         grow = (r_row + jnp.arange(D, dtype=jnp.int32)[:, None] * R).reshape(-1)
-        if mvm:
+
+        # 4. return aggregated rows to their owners: block d' of the
+        # partial sums belongs to the devices with data-coordinate d'
+        def owner_reduce(partials):
+            mine = jax.lax.psum_scatter(
+                partials, DATA_AXIS, scatter_dimension=0, tiled=True
+            )  # [1, R(*nf), ch]
+            return jax.lax.psum(mine, TABLE_AXIS)[0]
+
+        if mode == "mvm_segment":
             r_fields = a2a(fs_fields)
             seg = grow * nf + r_fields.reshape(-1)
             # mask rides as an extra channel: its segment-sum is the
@@ -304,29 +323,33 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
             sums_t = jax.vmap(
                 lambda r: jax.ops.segment_sum(r, seg, num_segments=D * R * nf)
             )(stacked)  # [k+1, D*R*nf]
-            partials = sums_t.reshape(K + 1, D, R * nf).transpose(1, 2, 0)
-        else:
-            from xflow_tpu.models.fm import stack_channels
-
-            stacked = stack_channels(occm_t, K)  # [ch, N]
-            rs = row_sums_sorted(stacked, grow, D * R)  # [D*R, ch]
-            partials = rs.reshape(D, R, -1)
-
-        # 4. return aggregated rows to their owners: block d' of the
-        # partial sums belongs to the devices with data-coordinate d'
-        mine = jax.lax.psum_scatter(
-            partials, DATA_AXIS, scatter_dimension=0, tiled=True
-        )  # [1, R(*nf), ch]
-        sums = jax.lax.psum(mine, TABLE_AXIS)[0]
-
-        if mvm:
+            sums = owner_reduce(sums_t.reshape(K + 1, D, R * nf).transpose(1, 2, 0))
             sums = sums.reshape(R, nf, K + 1)
             s, present = sums[..., :K], sums[..., K] > 0
             factors = jnp.where(present[..., None], s, 1.0)
             logits = jnp.prod(factors, axis=1).sum(axis=-1)
-        else:
-            from xflow_tpu.models.fm import fm_logits_from_sums
+        elif mode == "mvm_product":
+            from xflow_tpu.models.mvm import make_row_products
 
+            # log-space product channels are ADDITIVE over shards (sums
+            # of ln|v| / negative and zero counts), so the cross-shard
+            # reduction is the same rowsum + psum_scatter + psum as FM's;
+            # the op's bwd all-gathers the small [R, 4k] row aggregates
+            # over 'data' — the same traffic class as FM's backward
+            op = make_row_products(
+                lambda stacked, rows_: owner_reduce(
+                    row_sums_sorted(stacked, rows_, D * R).reshape(D, R, -1)
+                ),
+                lambda arr: jax.lax.all_gather(arr, DATA_AXIS, tiled=True),
+                K,
+            )
+            logits = op(occ_t[:K], mask_flat, grow).sum(axis=1)
+        else:
+            from xflow_tpu.models.fm import fm_logits_from_sums, stack_channels
+
+            stacked = stack_channels(occm_t, K)  # [ch, N]
+            rs = row_sums_sorted(stacked, grow, D * R)  # [D*R, ch]
+            sums = owner_reduce(rs.reshape(D, R, -1))
             logits = fm_logits_from_sums(sums, K, cfg)
         per_row = binary_logloss_from_logits(logits, labels)
         loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
@@ -335,60 +358,77 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
 
     fs_spec = P(DATA_AXIS, TABLE_AXIS, None, None)
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(
-            P((DATA_AXIS, TABLE_AXIS), None),  # table shard [S/(D*T), K]
-            fs_spec, fs_spec, fs_spec, fs_spec, fs_spec,  # fs_* [1,1,D,cap]
-            P(DATA_AXIS, None),  # labels [1, R]
-            P(DATA_AXIS, None),  # row_mask
-        ),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    def sharded_loss(tbl, fss, fsr, fsm, fso, fsf, labels, rm):
-        sq = lambda x: x[0, 0]
-        return local_loss(
-            tbl, sq(fss), sq(fsr), sq(fsm), sq(fso), sq(fsf), labels[0], rm[0]
-        )
+    def build(mode: str):
+        """One jitted step per row-side mode (its own collective program)."""
+        with_fields = mode == "mvm_segment"
 
-    def loss_for_grad(tbl, batch):
-        fsf = batch["fs_fields"] if mvm else batch["fs_slots"]  # unused for FM
-        return sharded_loss(
-            tbl,
-            batch["fs_slots"], batch["fs_row"], batch["fs_mask"],
-            batch["fs_off"], fsf,
-            batch["labels"].reshape(D, -1),
-            batch["row_mask"].reshape(D, -1),
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                P((DATA_AXIS, TABLE_AXIS), None),  # table shard [S/(D*T), K]
+                fs_spec, fs_spec, fs_spec, fs_spec, fs_spec,  # fs_* [1,1,D,cap]
+                P(DATA_AXIS, None),  # labels [1, R]
+                P(DATA_AXIS, None),  # row_mask
+            ),
+            out_specs=(P(), P()),
+            check_vma=False,
         )
+        def sharded_loss(tbl, fss, fsr, fsm, fso, fsf, labels, rm):
+            sq = lambda x: x[0, 0]
+            return local_loss(
+                mode, tbl, sq(fss), sq(fsr), sq(fsm), sq(fso), sq(fsf),
+                labels[0], rm[0],
+            )
 
-    def train_step(state: TrainState, batch: dict):
-        (loss, rows), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(
-            state.tables[tname], batch
-        )
-        new_tables, new_opt = optimizer.apply(
-            {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
-        )
-        metrics = {"loss": loss, "rows": rows}
-        return TrainState(new_tables, new_opt, state.step + 1), metrics
+        def loss_for_grad(tbl, batch):
+            # fs_fields only exists on the segment path; others pass
+            # fs_slots as an unused same-shaped dummy
+            fsf = batch["fs_fields"] if with_fields else batch["fs_slots"]
+            return sharded_loss(
+                tbl,
+                batch["fs_slots"], batch["fs_row"], batch["fs_mask"],
+                batch["fs_off"], fsf,
+                batch["labels"].reshape(D, -1),
+                batch["row_mask"].reshape(D, -1),
+            )
+
+        def train_step(state: TrainState, batch: dict):
+            (loss, rows), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(
+                state.tables[tname], batch
+            )
+            new_tables, new_opt = optimizer.apply(
+                {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
+            )
+            metrics = {"loss": loss, "rows": rows}
+            return TrainState(new_tables, new_opt, state.step + 1), metrics
+
+        return train_step, fullshard_batch_sharding(mesh, with_fields=with_fields)
 
     from xflow_tpu.parallel.mesh import state_shardings
 
-    bsh = fullshard_batch_sharding(mesh, with_fields=mvm)
     rep = NamedSharding(mesh, P())
-    jitted = None
+    jitted: dict = {}
 
     def call(state: TrainState, batch: dict):
-        nonlocal jitted
-        if jitted is None:
+        mode = (
+            ("mvm_segment" if "fs_fields" in batch else "mvm_product")
+            if mvm
+            else "fm"
+        )
+        if mode not in jitted:
+            step, bsh = build(mode)
             ssh = state_shardings(state, mesh)
-            jitted = jax.jit(
-                train_step,
-                in_shardings=(ssh, bsh),
-                out_shardings=(ssh, {"loss": rep, "rows": rep}),
-                donate_argnums=(0,),
+            jitted[mode] = (
+                jax.jit(
+                    step,
+                    in_shardings=(ssh, bsh),
+                    out_shardings=(ssh, {"loss": rep, "rows": rep}),
+                    donate_argnums=(0,),
+                ),
+                bsh,
             )
-        return jitted(state, {k: batch[k] for k in bsh})
+        fn, bsh = jitted[mode]
+        return fn(state, {k: batch[k] for k in bsh})
 
     return call

@@ -236,12 +236,25 @@ class Trainer:
                     f"{self.cfg.model.num_fields}; raise model.num_fields"
                 )
 
+    def _mvm_wants_fields(self, batch) -> bool:
+        """Does this MVM batch need per-occurrence fields in its plan?
+        False = the exclusive-fields product path (models/mvm.py): the
+        host verified no row repeats a field, so the step needs neither
+        the fields array nor the [B·nf] segment space. Routing is
+        per-batch under `auto` (single-process); duplicates raise under
+        `on` or multi-process (resolve_mvm_product)."""
+        from xflow_tpu.models.mvm import has_field_duplicates, resolve_mvm_product
+
+        excl = self.cfg.model.mvm_exclusive
+        dup = excl != "off" and has_field_duplicates(batch.fields, batch.mask)
+        return not resolve_mvm_product(excl, dup, jax.process_count())
+
     def _batch_arrays(self, batch, with_plan: bool = True) -> dict:
         """SparseBatch -> step input arrays (+ sorted-layout plan).
 
         On the sorted paths the step consumes ONLY the plan +
-        labels/row_mask (+ sorted_fields for MVM), so the row-major
-        [B, F] arrays are dropped — they would be dead ~24 MB
+        labels/row_mask (+ sorted_fields for MVM's segment path), so the
+        row-major [B, F] arrays are dropped — they would be dead ~24 MB
         host→device transfers per 64k-row batch. (Single-device eval
         also runs the sorted forward, so this holds for eval batches
         too; mesh eval passes `with_plan=False` and keeps row-major.)
@@ -254,6 +267,7 @@ class Trainer:
             )
 
             mvm = self.cfg.model.name == "mvm"
+            want_fields = mvm and self._mvm_wants_fields(batch)
             try:
                 out = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
                 out.update(
@@ -262,7 +276,7 @@ class Trainer:
                         np.asarray(batch.mask),
                         self.cfg,
                         self.mesh,
-                        fields=np.asarray(batch.fields) if mvm else None,
+                        fields=np.asarray(batch.fields) if want_fields else None,
                     )
                 )
                 return out
@@ -287,12 +301,14 @@ class Trainer:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
             arrays = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
-            mvm = self.cfg.model.name == "mvm"
+            want_fields = (
+                self.cfg.model.name == "mvm" and self._mvm_wants_fields(batch)
+            )
             plan = plan_sorted_stacked(
                 np.asarray(batch.slots),
                 np.asarray(batch.mask),
                 self.cfg.num_slots,
-                fields=np.asarray(batch.fields) if mvm else None,
+                fields=np.asarray(batch.fields) if want_fields else None,
                 num_sub=self._sorted_sub,
                 # the sharded engine wants a leading [D] axis even at D=1
                 always_stack=self._sorted_sharded,
@@ -303,7 +319,7 @@ class Trainer:
                 sorted_mask=plan.sorted_mask,
                 win_off=plan.win_off,
             )
-            if mvm:
+            if want_fields:
                 arrays["sorted_fields"] = plan.sorted_fields
         return arrays
 
