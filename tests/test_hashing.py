@@ -38,3 +38,29 @@ def test_slot_distribution_roughly_uniform():
     counts = np.bincount(s, minlength=64)
     assert counts.min() > 0.5 * counts.mean()
     assert counts.max() < 1.5 * counts.mean()
+
+
+def test_hash_int_tokens_matches_scalar():
+    from xflow_tpu.hashing import hash_int_tokens, hash_token
+
+    vals = np.array(
+        [0, 1, 9, 10, 99, 100, 999, 1000, 123456, 999999999, 10**9, 10**12,
+         10**15, 10**15 + 1, 10**16, 10**19, 2**64 - 1],
+        np.uint64,
+    )
+    for salt in (0, 12345):
+        got = hash_int_tokens(vals, salt)
+        want = np.array(
+            [hash_token(str(int(v)), salt) for v in vals], np.uint64
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_hash_int_tokens_random_parity():
+    from xflow_tpu.hashing import hash_int_tokens, hash_token
+
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 20_000_000, 2000).astype(np.uint64)
+    got = hash_int_tokens(vals)
+    want = np.array([hash_token(str(int(v))) for v in vals], np.uint64)
+    np.testing.assert_array_equal(got, want)

@@ -283,3 +283,45 @@ def test_fullshard_product_matches_single_device(mesh_shape):
         np.asarray(state2.tables["v"]),
         rtol=2e-4, atol=1e-6,
     )
+
+
+def test_plus_one_form_all_paths_agree():
+    """model.mvm_plus_one (the reference gradient's bias-augmented
+    factor form, mvm_worker.cc:153-157): row-major, segment, and
+    product paths compute the same logits."""
+    cfg = _cfg(**{"model.mvm_plus_one": True})
+    model = get_model("mvm")
+    rng = np.random.default_rng(7)
+    batch = _exclusive_batch(rng)
+    v = jnp.asarray(
+        (rng.standard_normal((S, cfg.model.v_dim)) * 0.1).astype(np.float32)
+    )
+    ref = np.asarray(
+        model.forward({"v": v}, {k: jnp.asarray(a) for k, a in batch.items()}, cfg)
+    )
+    seg = np.asarray(model.forward({"v": v}, _sorted_arrays(batch, True), cfg))
+    prod = np.asarray(model.forward({"v": v}, _sorted_arrays(batch, False), cfg))
+    scale = np.abs(ref).max() * 1e-5 + 1e-10
+    np.testing.assert_allclose(seg, ref, rtol=1e-4, atol=scale)
+    np.testing.assert_allclose(prod, ref, rtol=1e-4, atol=scale)
+
+
+def test_plus_one_learns_where_plain_product_cannot():
+    """With 8+ fields and the reference's 1e-2 v init, the plain product
+    model's gradients vanish multiplicatively (each is a product of the
+    row's OTHER ~1e-2 factors); the plus-one form keeps factors near 1
+    and learns. This is why mvm_plus_one exists."""
+    from xflow_tpu.train.step import loss_fn
+
+    model, opt = get_model("mvm"), get_optimizer("ftrl")
+    rng = np.random.default_rng(8)
+    batch = _exclusive_batch(rng)
+    batch["mask"][:] = 1.0  # all 8 fields present: Π_others ~ (1e-2)^7
+    last = {}
+    for plus in (False, True):
+        cfg = _cfg(**{"model.mvm_plus_one": plus})
+        st = init_state(model, opt, cfg)
+        g = jax.grad(loss_fn)(st.tables, _sorted_arrays(batch, False), model, cfg)
+        last[plus] = float(np.abs(np.asarray(g["v"])).max())
+    assert last[False] < 1e-9  # multiplicatively vanished
+    assert last[True] > 1e-4  # alive

@@ -44,3 +44,39 @@ def test_zipf_deterministic(tmp_path):
     b = generate_shards(str(tmp_path / "b"), 1, 50, num_fields=3, ids_per_field=40,
                         zipf_alpha=1.2, seed=5)[0]
     assert open(a).read() == open(b).read()
+
+
+def test_bulk_writer_format_and_seen(tmp_path):
+    """generate_shards_bulk emits parser-identical libffm lines and its
+    `seen` map marks exactly the emitted feature ids."""
+    from xflow_tpu.config import DataConfig
+    from xflow_tpu.data.pipeline import batch_iterator
+    from xflow_tpu.data.synth import generate_shards_bulk
+
+    prefix = str(tmp_path / "bulk")
+    paths, seen = generate_shards_bulk(
+        prefix, 1, 500, num_fields=6, ids_per_field=40, seed=3,
+        zipf_alpha=1.1, chunk_rows=128, track_seen=True,
+    )
+    lines = open(paths[0]).read().splitlines()
+    assert len(lines) == 500
+    import re
+
+    pat = re.compile(r"^[01]\t(\d+:\d+:0\.\d{4})( \d+:\d+:0\.\d{4}){5}$")
+    assert all(pat.match(ln) for ln in lines[:50])
+    # parser agreement: every row parses to 6 in-range features
+    cfg = DataConfig(max_nnz=8, batch_size=64, log2_slots=16)
+    gids = set()
+    labels = []
+    for batch in batch_iterator(paths[0], cfg):
+        rm = batch.row_mask > 0
+        labels.extend(batch.labels[rm].tolist())
+        assert (batch.mask.sum(axis=1)[rm] == 6).all()
+        for row_f, row_m in zip(batch.fields[rm], batch.mask[rm]):
+            assert set(row_f[row_m > 0].tolist()) == set(range(6))
+    assert 0.1 < np.mean(labels) < 0.9  # planted truth gives both classes
+    # seen map: re-read the raw ids from the text and compare exactly
+    for ln in lines:
+        for tok in ln.split("\t")[1].split(" "):
+            gids.add(int(tok.split(":")[1]))
+    assert set(np.flatnonzero(seen).tolist()) == gids

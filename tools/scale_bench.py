@@ -1,0 +1,183 @@
+"""Realistic-scale baseline run (BASELINE.md configs 2-3; VERDICT r2
+missing #1): a ≥10M-row, ≥10^7-distinct-feature Zipf dataset on disk,
+trained end-to-end (file → C++ parser → sorted plans → device) with the
+full `Trainer`, and the result — e2e throughput, held-out AUC/logloss,
+exact collision accounting — recorded as one JSON (BENCH_SCALE.json,
+checked into the repo so later rounds regress against it).
+
+No public CTR dataset can be downloaded in this environment (zero
+egress), so the dataset is synthetic but *shaped* like Criteo-class
+data: heavy-tailed feature frequencies (Zipf α≈1.1 per field), ~10.8M
+distinct feature ids over 18 fields hashed into 2^24 slots (real
+collision pressure), labels from a planted sparse linear truth with
+noise (so held-out AUC measures genuine learning, with a cold tail the
+model cannot see at train time — exactly real CTR's regime).
+
+Run on the TPU host:  python tools/scale_bench.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def ensure_data(args) -> dict:
+    """Generate train/test shards (reused across runs if present);
+    returns generation stats + the exact collision accounting."""
+    from xflow_tpu.data.synth import generate_shards_bulk
+    from xflow_tpu.hashing import hash_int_tokens, slots_of
+
+    os.makedirs(args.data_dir, exist_ok=True)
+    train = os.path.join(args.data_dir, "train")
+    test = os.path.join(args.data_dir, "test")
+    meta_path = os.path.join(args.data_dir, "meta.json")
+    if os.path.exists(meta_path) and not args.force_gen:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if all(
+            meta.get(key) == getattr(args, key)
+            for key in ("rows", "test_rows", "fields", "ids_per_field",
+                        "zipf_alpha", "log2_slots")
+        ):
+            print(f"# reusing dataset in {args.data_dir}", file=sys.stderr)
+            return meta
+    t0 = time.perf_counter()
+    # same truth_seed ties train/test to one concept; different row seeds
+    _, seen_tr = generate_shards_bulk(
+        train, 1, args.rows, num_fields=args.fields,
+        ids_per_field=args.ids_per_field, seed=1, truth_seed=7,
+        zipf_alpha=args.zipf_alpha, track_seen=True,
+    )
+    _, seen_te = generate_shards_bulk(
+        test, 1, args.test_rows, num_fields=args.fields,
+        ids_per_field=args.ids_per_field, seed=2, truth_seed=7,
+        zipf_alpha=args.zipf_alpha, track_seen=True,
+    )
+    gen_s = time.perf_counter() - t0
+    # exact collision accounting from the emitted-id map — no 180M-token
+    # file re-scan; hash_int_tokens is bit-identical to hashing str(gid)
+    gids = np.flatnonzero(seen_tr | seen_te)
+    hashes = hash_int_tokens(gids.astype(np.uint64))
+    slots = slots_of(hashes, args.log2_slots)
+    n_tok = int(gids.size)
+    n_slot = int(np.unique(slots).size)
+    meta = {
+        "rows": args.rows,
+        "test_rows": args.test_rows,
+        "fields": args.fields,
+        "ids_per_field": args.ids_per_field,
+        "zipf_alpha": args.zipf_alpha,
+        "gen_seconds": round(gen_s, 1),
+        "gen_rows_per_sec": round((args.rows + args.test_rows) / gen_s, 1),
+        "train_bytes": os.path.getsize(train + "-00000"),
+        "distinct_features": n_tok,
+        "distinct_hash64": int(np.unique(hashes).size),
+        "distinct_slots": n_slot,
+        "log2_slots": args.log2_slots,
+        "collision_rate": round(1.0 - n_slot / n_tok, 6) if n_tok else 0.0,
+        "table_occupancy_bound": round(n_slot / float(1 << args.log2_slots), 6),
+    }
+    with open(meta_path, "w") as f:
+        json.dump(meta, f, indent=1)
+    print(f"# generated: {json.dumps(meta)}", file=sys.stderr)
+    return meta
+
+
+def run_model(name: str, args) -> dict:
+    from xflow_tpu.config import Config, override
+    from xflow_tpu.train.trainer import Trainer
+
+    cfg = override(
+        Config(),
+        **{
+            "model.name": name,
+            "data.train_path": os.path.join(args.data_dir, "train"),
+            "data.test_path": os.path.join(args.data_dir, "test"),
+            "data.batch_size": args.batch,
+            "data.max_nnz": args.fields,
+            "data.log2_slots": args.log2_slots,
+            "model.num_fields": args.fields,
+            "train.epochs": args.epochs,
+            "train.pred_dump": False,
+            "train.log_every": 0,
+            # plain-product MVM's exact gradients vanish multiplicatively
+            # at 18 all-present fields with the 1e-2 reference init
+            # (tests/test_mvm_product.py::test_plus_one_learns_...), so
+            # the scale baseline records the bias-augmented factor form —
+            # the one the reference's own hand gradient assumes
+            **({"model.mvm_plus_one": args.mvm_plus_one} if name == "mvm" else {}),
+        },
+    )
+    trainer = Trainer(cfg)
+    res = trainer.fit()
+    t0 = time.perf_counter()
+    auc, logloss = trainer.evaluate(dump=False)
+    eval_s = time.perf_counter() - t0
+    rec = {
+        "examples_per_sec_e2e": round(res.examples_per_sec, 1),
+        "train_seconds": round(res.seconds, 1),
+        "steps": res.steps,
+        "epochs": res.epochs,
+        "examples": res.examples,
+        "last_loss": round(res.last_loss, 6),
+        "test_auc": round(auc, 6),
+        "test_logloss": round(logloss, 6),
+        "eval_seconds": round(eval_s, 1),
+        "occupancy": {k: round(v, 6) for k, v in res.occupancy.items()},
+    }
+    if name == "mvm":
+        rec["mvm_plus_one"] = args.mvm_plus_one
+    print(f"# {name}: {json.dumps(rec)}", file=sys.stderr)
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--test-rows", type=int, default=1_000_000)
+    ap.add_argument("--fields", type=int, default=18)
+    ap.add_argument("--ids-per-field", type=int, default=600_000)
+    ap.add_argument("--zipf-alpha", type=float, default=1.1)
+    ap.add_argument("--log2-slots", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--models", default="lr,fm,mvm")
+    ap.add_argument("--mvm-plus-one", action=argparse.BooleanOptionalAction,
+                    default=True)
+    ap.add_argument("--data-dir", default=os.path.join(REPO, "scale_data"))
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SCALE.json"))
+    ap.add_argument("--force-gen", action="store_true")
+    args = ap.parse_args()
+
+    meta = ensure_data(args)
+    import jax
+
+    record = {
+        "dataset": meta,
+        "device": str(jax.devices()[0]),
+        "host_cores": os.cpu_count(),
+        "batch_size": args.batch,
+        "epochs": args.epochs,
+        "models": {},
+    }
+    for name in args.models.split(","):
+        record["models"][name] = run_model(name, args)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({"metric": "scale_bench", "out": args.out,
+                      **{f"{m}_auc": r["test_auc"]
+                         for m, r in record["models"].items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

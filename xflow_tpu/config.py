@@ -86,6 +86,16 @@ class ModelConfig:
     # collective programs). "on": require exclusive fields (raise on
     # duplicates). "off": always the general segment path.
     mvm_exclusive: str = "auto"
+    # MVM factor form: False = plain view-sum product Π_f s (the
+    # reference's live forward, mvm_worker.cc:202); True = Π_f (1 + s),
+    # the bias-augmented form its OWN hand gradient assumes
+    # (mvm_worker.cc:153-157 divides by 1 + v_sum; the `1+` forward is
+    # commented out at :201). The plus-one form is what makes MVM
+    # learnable from small inits: factors sit near 1 instead of near 0,
+    # so the product — and every gradient, itself a product of the
+    # row's OTHER factors — does not vanish multiplicatively with the
+    # field count. Works on both the product and segment paths.
+    mvm_plus_one: bool = False
     fm_standard: bool = True
     fm_half: bool = True
     # fused [S, 1+k] w+v table (one gather+scatter pass instead of two;
@@ -197,13 +207,20 @@ class TrainConfig:
     eval_buckets: int = -1
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
     profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
-    # preemption: on SIGTERM/SIGINT save a checkpoint at the next step
-    # boundary and return early (single-process; multi-process preemption
-    # relies on checkpoint_every cadence — a mid-loop signal-triggered
-    # collective save cannot be made rank-symmetric without per-step
-    # collectives). The reference loses all weights on any termination
-    # (SURVEY.md §5 A3: server state is in-memory only).
+    # preemption: on SIGTERM/SIGINT save a checkpoint at the next
+    # coordination point and return early. Single-process coordinates
+    # every step; multi-process runs agree on "stop at step N" through a
+    # tiny flag allgather every `signal_sync_every` steps (a signal on
+    # ANY rank stops ALL ranks at the same step, so the collective save
+    # is rank-symmetric — round-2 weak #6). The reference loses all
+    # weights on any termination (SURVEY.md §5 A3: server state is
+    # in-memory only).
     ckpt_on_signal: bool = True
+    # multi-process signal-coordination cadence, in steps (0 disables
+    # the periodic allgather; preemption then degrades to the
+    # checkpoint_every cadence). One [1]-int32 host allgather per
+    # `signal_sync_every` steps is the entire cost.
+    signal_sync_every: int = 100
 
 
 @dataclass(frozen=True)

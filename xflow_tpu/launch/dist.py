@@ -1,0 +1,166 @@
+"""Multi-machine launcher (SURVEY.md §2 C17).
+
+The reference's multi-machine bring-up is four hand-run shell scripts
+and a hosts file (`run_ps_dist.sh:9-16`: start_scheduler.sh on machine
+1, start_server.sh there too, start_worker.sh on each worker machine,
+`scripts/hosts` listing addresses). The SPMD analog needs no role
+split: every machine runs ONE identical `xflow train` process; rank 0's
+address is the rendezvous coordinator (`jax.distributed.initialize`
+replaces the ZMQ scheduler), and rank k reads shard `<prefix>-%05d` % k
+(`lr_worker.cc:210` convention).
+
+`xflow launch-dist` drives N machines from one seat:
+
+    xflow launch-dist --hosts hosts.txt -- \
+        --train /data/train --test /data/test --model fm ...
+
+- `hosts.txt`: one host per line (optionally ``user@host``), comments
+  with ``#`` — the same shape as the reference's ``scripts/hosts``. The
+  FIRST host is rank 0 / the coordinator.
+- each rank is started over ssh (``--ssh-cmd`` to swap in a different
+  remote runner) with the ``XFLOW_*`` env contract
+  (parallel/distributed.py): ``XFLOW_COORDINATOR=<host0>:<port>``,
+  ``XFLOW_NUM_PROCESSES=N``, ``XFLOW_PROCESS_ID=k``.
+- ``--workdir`` may contain ``{rank}`` / ``{host}`` placeholders so
+  ranks run in separate directories (per-rank pred/metric files stay
+  separate even on a shared filesystem).
+- ``--dry-run`` prints the exact per-host command lines instead of
+  running them — for clusters driven by something other than plain ssh
+  (e.g. ``gcloud compute tpus tpu-vm ssh --worker=k``), paste the
+  printed env + command into that runner. See docs/DISTRIBUTED.md for
+  the TPU-pod walkthrough (where `jax.distributed.initialize()`
+  auto-detects and `XFLOW_AUTO_DIST=1` is all a pod slice needs).
+
+Unlike `launch-local` (single-machine emulation, forces CPU children),
+launch-dist does NOT touch JAX_PLATFORMS: each machine's ambient
+accelerators are exactly what the rank should use. Extra env goes
+through repeatable ``--env K=V``.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+
+
+def parse_hosts(path: str) -> list[str]:
+    """Hosts file -> host list. One host per line (optionally user@host);
+    blank lines and '#' comments ignored. First host = rank 0."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                hosts.append(line.split()[0])
+    if not hosts:
+        raise ValueError(f"hosts file {path!r} lists no hosts")
+    return hosts
+
+
+def rank_command(
+    host: str,
+    rank: int,
+    hosts: list[str],
+    forward_args: list[str],
+    port: int,
+    workdir: str = "",
+    python: str = "",
+    env_extra: dict | None = None,
+) -> str:
+    """The exact shell line rank `rank` runs on `host` (also what
+    --dry-run prints)."""
+    coordinator_host = hosts[0].rsplit("@", 1)[-1]  # strip user@ for the address
+    env = {
+        "XFLOW_COORDINATOR": f"{coordinator_host}:{port}",
+        "XFLOW_NUM_PROCESSES": str(len(hosts)),
+        "XFLOW_PROCESS_ID": str(rank),
+        **(env_extra or {}),
+    }
+    py = python or "python3"
+    parts = []
+    if workdir:
+        wd = workdir.format(rank=rank, host=host.rsplit("@", 1)[-1])
+        parts.append(f"mkdir -p {shlex.quote(wd)} && cd {shlex.quote(wd)}")
+    parts.append(
+        " ".join(
+            [*(f"{k}={shlex.quote(v)}" for k, v in env.items()),
+             py, "-m", "xflow_tpu", "train",
+             *(shlex.quote(a) for a in forward_args)]
+        )
+    )
+    return " && ".join(parts)
+
+
+def launch_dist(
+    hosts: list[str],
+    forward_args: list[str],
+    port: int = 29431,
+    ssh_cmd: str = "ssh",
+    workdir: str = "",
+    python: str = "",
+    env_extra: dict | None = None,
+    dry_run: bool = False,
+) -> int:
+    """Start one rank per host over ssh and wait for all of them.
+
+    Output streams are inherited (prefix-free, like the reference's
+    `start_worker.sh` background jobs). FAIL-FAST: SPMD ranks block in
+    collectives when a peer dies, so the first rank to exit NONZERO
+    terminates the rest (after `grace_s` seconds for the stragglers'
+    own error output) and its exit code is returned. Rank 0 (the first
+    host) is started LAST so the coordinator's listener never races the
+    workers' connect loop backwards — JAX ranks retry the rendezvous,
+    so ordering is cosmetic, but starting workers first keeps slow-host
+    stragglers off the critical path.
+    """
+    import time
+
+    if forward_args and forward_args[0] == "--":
+        forward_args = forward_args[1:]
+    cmds = [
+        rank_command(h, i, hosts, forward_args, port, workdir, python, env_extra)
+        for i, h in enumerate(hosts)
+    ]
+    if dry_run:
+        for i, (h, c) in enumerate(zip(hosts, cmds)):
+            print(f"# rank {i} on {h}:")
+            print(f"{ssh_cmd} {h} {shlex.quote(c)}")
+        return 0
+    procs = []
+    grace_s = 10.0
+    try:
+        for i in reversed(range(len(hosts))):
+            procs.append(
+                subprocess.Popen([*shlex.split(ssh_cmd), hosts[i], cmds[i]])
+            )
+        first_bad = 0
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = [c for c in codes if c]  # nonzero AND not None
+            if bad and not first_bad:
+                first_bad = bad[0]
+                print(
+                    f"launch-dist: a rank exited with code {first_bad}; "
+                    f"terminating the remaining ranks in {grace_s:.0f}s "
+                    "(peers would otherwise block in collectives forever)",
+                    file=sys.stderr,
+                )
+                deadline = time.time() + grace_s
+                while time.time() < deadline and any(
+                    p.poll() is None for p in procs
+                ):
+                    time.sleep(0.5)
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+            if all(c is not None for c in codes):
+                return first_bad or next((c for c in codes if c), 0)
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+        raise

@@ -200,7 +200,7 @@ def make_row_products(reduce_rows, broadcast_rows, k: int):
 
 
 def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
-                        win_off, rows, nf, bf16=False):
+                        win_off, rows, nf, bf16=False, plus=0.0):
     """One sub-batch: [K8, Np] windowed gather + one segment-sum keyed on
     `row * nf + field` → logits [rows]."""
     from xflow_tpu.ops.sorted_table import table_gather_sorted
@@ -217,12 +217,12 @@ def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
     )(stacked)  # [k+1, rows*nf]
     s = sums_t[:k].reshape(k, rows, nf)
     present = (sums_t[k] > 0).reshape(rows, nf)
-    factors = jnp.where(present[None, :, :], s, 1.0)  # [k, rows, nf]
+    factors = jnp.where(present[None, :, :], s + plus, 1.0)  # [k, rows, nf]
     return jnp.prod(factors, axis=-1).sum(axis=0)  # [rows]
 
 
 def _forward_sorted_product_one(v, sorted_slots, sorted_row, sorted_mask,
-                                win_off, rows, bf16=False):
+                                win_off, rows, bf16=False, plus=0.0):
     """One sub-batch on the exclusive-fields product path: windowed
     gather + the SAME [rows, ~32] row-sum kernel FM uses — no
     per-(row, field) segment space exists at all."""
@@ -235,7 +235,10 @@ def _forward_sorted_product_one(v, sorted_slots, sorted_row, sorted_mask,
         lambda arr: arr,
         k,
     )
-    P = op(occ_t[:k], sorted_mask, sorted_row)  # [rows, k]
+    # plus-one form: the per-occurrence factor is (plus + v) — with
+    # exclusive fields this equals the per-field (plus + s), so the
+    # same exclusive-product op covers both factor forms
+    P = op(occ_t[:k] + plus, sorted_mask, sorted_row)  # [rows, k]
     return P.sum(axis=1)
 
 
@@ -260,10 +263,11 @@ def _forward_sorted(tables, batch, cfg):
 
     v = tables["v"]
     bf16 = cfg.data.sorted_bf16
+    plus = 1.0 if cfg.model.mvm_plus_one else 0.0
     if "sorted_fields" not in batch:
         return map_sub_batches(
             lambda ss, sr, sm, wo, rows: _forward_sorted_product_one(
-                v, ss, sr, sm, wo, rows, bf16
+                v, ss, sr, sm, wo, rows, bf16, plus
             ),
             batch,
             ("sorted_slots", "sorted_row", "sorted_mask", "win_off"),
@@ -272,7 +276,7 @@ def _forward_sorted(tables, batch, cfg):
     nf = cfg.model.num_fields
     return map_sub_batches(
         lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(
-            v, ss, sr, sm, sf, wo, rows, nf, bf16
+            v, ss, sr, sm, sf, wo, rows, nf, bf16, plus
         ),
         batch,
         ("sorted_slots", "sorted_row", "sorted_mask", "sorted_fields", "win_off"),
@@ -292,7 +296,8 @@ def forward(tables, batch, cfg):
     # downstream product-of-fields amplifies any bf16 rounding
     s = jnp.einsum("bfn,bfk->bnk", onehot, vg, precision=jax.lax.Precision.HIGHEST)
     present = onehot.sum(axis=1) > 0  # [B, nf]
-    factors = jnp.where(present[..., None], s, 1.0)
+    plus = 1.0 if cfg.model.mvm_plus_one else 0.0
+    factors = jnp.where(present[..., None], s + plus, 1.0)
     return jnp.prod(factors, axis=1).sum(axis=-1)  # [B]
 
 

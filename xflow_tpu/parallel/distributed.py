@@ -36,6 +36,13 @@ def maybe_initialize(
     if process_id is None:
         pid_env = os.environ.get("XFLOW_PROCESS_ID")
         process_id = int(pid_env) if pid_env is not None else None
+    if not coordinator and os.environ.get("XFLOW_AUTO_DIST"):
+        # TPU pod slices (and other managed clusters) publish their own
+        # topology: a no-arg initialize reads it from the runtime
+        # metadata, so a pod launch needs no XFLOW_* contract at all —
+        # export XFLOW_AUTO_DIST=1 on every worker (docs/DISTRIBUTED.md)
+        jax.distributed.initialize()
+        return jax.process_index()
     if coordinator and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator,

@@ -278,6 +278,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
     tname = "v" if mvm else "wv"
     nf = cfg.model.num_fields
     bf16 = cfg.data.sorted_bf16
+    plus = 1.0 if cfg.model.mvm_plus_one else 0.0
 
     def local_loss(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
                    labels, row_mask):
@@ -326,7 +327,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
             sums = owner_reduce(sums_t.reshape(K + 1, D, R * nf).transpose(1, 2, 0))
             sums = sums.reshape(R, nf, K + 1)
             s, present = sums[..., :K], sums[..., K] > 0
-            factors = jnp.where(present[..., None], s, 1.0)
+            factors = jnp.where(present[..., None], s + plus, 1.0)
             logits = jnp.prod(factors, axis=1).sum(axis=-1)
         elif mode == "mvm_product":
             from xflow_tpu.models.mvm import make_row_products
@@ -343,7 +344,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
                 lambda arr: jax.lax.all_gather(arr, DATA_AXIS, tiled=True),
                 K,
             )
-            logits = op(occ_t[:K], mask_flat, grow).sum(axis=1)
+            logits = op(occ_t[:K] + plus, mask_flat, grow).sum(axis=1)
         else:
             from xflow_tpu.models.fm import fm_logits_from_sums, stack_channels
 

@@ -409,9 +409,11 @@ class Trainer:
     # ------------------------------------------------------------------ train
     def _install_signal_checkpoint(self):
         """Preemption hook (train.ckpt_on_signal): SIGTERM/SIGINT set a
-        flag; the fit loop saves a checkpoint at the next step boundary
-        and returns early. Single-process, main-thread only (a signal-
-        triggered collective save cannot be rank-symmetric); the second
+        flag; the fit loop saves a checkpoint at the next COORDINATION
+        point and returns early. Single-process coordinates every step;
+        multi-process ranks agree through the `signal_sync_every` flag
+        allgather (`_coordinated_signal`) so everyone stops — and saves,
+        collectively — at the same step. Main-thread only; the second
         signal falls through to the previous handler, so a double Ctrl-C
         still kills a stuck run. Reference comparison (SURVEY.md §5 A3):
         any termination loses all server-side weights."""
@@ -419,13 +421,19 @@ class Trainer:
         import threading
 
         cfg = self.cfg
+        multiproc_ok = jax.process_count() == 1 or cfg.train.signal_sync_every > 0
         if not (
-            cfg.train.ckpt_on_signal
-            and cfg.train.checkpoint_dir
-            and jax.process_count() == 1
-            and threading.current_thread() is threading.main_thread()
+            cfg.train.ckpt_on_signal and cfg.train.checkpoint_dir and multiproc_ok
         ):
+            # config-off is RANK-SYMMETRIC (identical config everywhere),
+            # so returning None — which skips the coordination allgathers
+            # entirely — is safe
             return None, lambda: None
+        if threading.current_thread() is not threading.main_thread():
+            # cannot install handlers here, but MUST keep participating
+            # in the flag allgathers: thread placement can differ across
+            # ranks, and a rank that skipped them would desync the rest
+            return {}, lambda: None
         flag = {}
         prev = {}
 
@@ -454,10 +462,34 @@ class Trainer:
             jax.profiler.start_trace(cfg.train.profile_dir)
         last_metrics = None
         sig_flag, sig_restore = self._install_signal_checkpoint()
+        multiproc = jax.process_count() > 1
+        sync_every = cfg.train.signal_sync_every
 
         def pending_signal() -> int:
             return int(sig_flag["sig"]) if sig_flag and "sig" in sig_flag else 0
 
+        def coordinated_signal() -> int:
+            """The stop decision every rank computes IDENTICALLY: local
+            flag single-process; the max over all ranks' flags multi-
+            process (one [1]-int32 host allgather), called at the same
+            step on every rank — so a signal on ANY rank stops ALL ranks
+            at the same step and the collective save stays symmetric."""
+            if sig_flag is None:
+                return 0
+            if not multiproc:
+                return pending_signal()
+            from jax.experimental import multihost_utils
+
+            got = int(
+                np.asarray(
+                    multihost_utils.process_allgather(np.int32(pending_signal()))
+                ).max()
+            )
+            if got and not pending_signal():
+                sig_flag["sig"] = got  # adopt the peer's signal for reporting
+            return got
+
+        stop_sig = 0
         try:
             for epoch in range(cfg.train.epochs):
                 for batch, arrays in self._coordinated_batches(path):
@@ -483,19 +515,23 @@ class Trainer:
                         and res.steps % cfg.train.checkpoint_every == 0
                     ):
                         self.save_checkpoint()
-                    if pending_signal():
-                        break
-                res.epochs = epoch + (0 if pending_signal() else 1)
-                if not pending_signal():
+                    if not multiproc or (sync_every and res.steps % sync_every == 0):
+                        stop_sig = coordinated_signal()
+                        if stop_sig:
+                            break
+                res.epochs = epoch + (0 if stop_sig else 1)
+                if not stop_sig:
                     if (epoch + 1) % 30 == 0:
                         print(f"epoch : {epoch}", file=sys.stderr)
                     if cfg.train.eval_every and (epoch + 1) % cfg.train.eval_every == 0:
                         auc, ll = self.evaluate(dump=False)
                         self.metrics.log({"epoch": epoch, "eval_auc": auc, "eval_logloss": ll})
-                # re-check AFTER the epoch eval too: a signal landing there
-                # (or between the last step and loop exit) must not be lost
-                if pending_signal():
-                    res.interrupted = pending_signal()
+                    # re-check AFTER the epoch eval too (an end-of-epoch
+                    # coordination point): a signal landing there, or
+                    # between sync cadences, must not be lost
+                    stop_sig = coordinated_signal()
+                if stop_sig:
+                    res.interrupted = stop_sig
                     self.metrics.log({"interrupted": res.interrupted, "step": res.steps})
                     print(
                         f"signal {res.interrupted}: checkpointing at step "
