@@ -107,25 +107,34 @@ def test_mvm_absent_field_is_identity():
 
 
 def test_init_tables_shapes_and_init():
+    # default storage is PACKED [S/8, 8K] (ops/sorted_table.pack_table:
+    # logical [S, 11] would be (8,128)-tile-padded to 11.6x its bytes)
     cfg = small_cfg(**{"model.fm_fused": False})
     key = jax.random.PRNGKey(0)
     t_fm = init_tables(get_model("fm"), cfg, key)
-    assert t_fm["w"].shape == (1 << LOG2,)
-    assert t_fm["v"].shape == (1 << LOG2, 3)
+    assert t_fm["w"].shape == (1 << LOG2,)  # scalar tables stay 1-D
+    assert t_fm["v"].shape == ((1 << LOG2) // 8, 8 * 3)
     assert float(jnp.abs(t_fm["w"]).max()) == 0.0  # w starts at 0 (ftrl.h:27-36)
     assert 0 < float(jnp.abs(t_fm["v"]).mean()) < 0.1  # ~N(0,1)*1e-2 (ftrl.h:117)
     cfg_sgd = small_cfg(**{"optim.name": "sgd", "model.fm_fused": False})
     t_sgd = init_tables(get_model("fm"), cfg_sgd, key)
     np.testing.assert_allclose(np.asarray(t_sgd["v"]), 1e-3)  # sgd.h:69
+    # packed_tables=off keeps the logical layout
+    cfg_off = small_cfg(**{"model.fm_fused": False, "data.packed_tables": "off"})
+    t_off = init_tables(get_model("fm"), cfg_off, key)
+    assert t_off["v"].shape == (1 << LOG2, 3)
 
 
 def test_init_tables_fused_fm():
+    from xflow_tpu.ops.sorted_table import unpack_table
+
     cfg = small_cfg()  # fm_fused defaults True
     t = init_tables(get_model("fm"), cfg, jax.random.PRNGKey(0))
     assert set(t) == {"wv"}
-    assert t["wv"].shape == (1 << LOG2, 4)  # 1 + v_dim
-    assert float(jnp.abs(t["wv"][:, 0]).max()) == 0.0  # w column zero-init
-    assert 0 < float(jnp.abs(t["wv"][:, 1:]).mean()) < 0.1  # v columns random
+    assert t["wv"].shape == ((1 << LOG2) // 8, 8 * 4)  # packed, K = 1 + v_dim
+    logical = np.asarray(unpack_table(t["wv"], 4))
+    assert float(np.abs(logical[:, 0]).max()) == 0.0  # w column zero-init
+    assert 0 < float(np.abs(logical[:, 1:]).mean()) < 0.1  # v columns random
 
 
 def test_fm_fused_matches_two_table_layout():

@@ -1,0 +1,194 @@
+"""Packed [S/8, 8K] table storage (ops/sorted_table.pack_table).
+
+TPU HBM buffers are (8, 128)-tiled: a logical [S, 11] f32 table is
+stored [S, 128] — 11.6× its logical bytes (3 × 8 GB of FM FTRL state at
+2^24 slots; the round-3 scale run OOM'd exactly there) — and every
+elementwise optimizer pass runs at 11/128 lane efficiency. Packed
+storage fixes both; consumers detect the layout FROM THE SHAPE
+(`pack_of`), so these tests pin: layout equivalence of every op,
+training equality against the logical layout, and checkpoint
+cross-layout migration.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.models import get_model
+from xflow_tpu.ops.sorted_table import (
+    _gather_xla,
+    _scatter_xla,
+    pack_of,
+    pack_table,
+    plan_sorted_batch,
+    table_gather_sorted,
+    table_rows,
+    unpack_table,
+)
+from xflow_tpu.optim import get_optimizer
+from xflow_tpu.train.state import init_state
+from xflow_tpu.train.step import make_train_step
+
+LOG2 = 13
+S = 1 << LOG2
+K = 11
+B, F = 64, 8
+
+
+def test_pack_roundtrip_and_detection():
+    t = np.arange(S * K, dtype=np.float32).reshape(S, K)
+    tp = pack_table(t)
+    assert tp.shape == (S // 8, 8 * K)
+    np.testing.assert_array_equal(unpack_table(tp, K), t)
+    # slot s lives at [s//8, (s%8)*K:(s%8+1)*K]
+    np.testing.assert_array_equal(tp[3, 2 * K : 3 * K], t[3 * 8 + 2])
+    assert pack_of(t, K) == 1
+    assert pack_of(tp, K) == 8
+    with pytest.raises(ValueError, match="neither"):
+        pack_of(np.zeros((S, K + 1), np.float32), K)
+
+
+def test_gather_scatter_layout_equivalence():
+    """The windowed gather and its scatter VJP produce IDENTICAL results
+    from logical and packed storage (packed gradient = packed logical
+    gradient)."""
+    rng = np.random.default_rng(0)
+    t = rng.standard_normal((S, K)).astype(np.float32)
+    slots = rng.integers(0, S, (B, F)).astype(np.int32)
+    mask = np.ones((B, F), np.float32)
+    plan = plan_sorted_batch(slots, mask, S)
+    ss, wo = jnp.asarray(plan.sorted_slots), jnp.asarray(plan.win_off)
+
+    got_l = table_gather_sorted(jnp.asarray(t), ss, wo, False, 1)
+    got_p = table_gather_sorted(jnp.asarray(pack_table(t)), ss, wo, False, 8)
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(got_p))
+
+    d = rng.standard_normal(got_l.shape).astype(np.float32)
+
+    def grad_for(tbl, pack):
+        _, vjp = jax.vjp(lambda x: table_gather_sorted(x, ss, wo, False, pack), tbl)
+        return np.asarray(vjp(jnp.asarray(d))[0])
+
+    g_l = grad_for(jnp.asarray(t), 1)
+    g_p = grad_for(jnp.asarray(pack_table(t)), 8)
+    assert g_p.shape == (S // 8, 8 * K)
+    np.testing.assert_allclose(unpack_table(g_p, K), g_l, rtol=1e-6, atol=1e-7)
+
+
+def test_xla_fallback_layout_equivalence():
+    rng = np.random.default_rng(1)
+    t = rng.standard_normal((S, K)).astype(np.float32)
+    slots = jnp.asarray(rng.integers(0, S, 500).astype(np.int32))
+    got_l = _gather_xla(jnp.asarray(t), slots, None, 1)
+    got_p = _gather_xla(jnp.asarray(pack_table(t)), slots, None, 8)
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(got_p))
+    d = rng.standard_normal(got_l.shape).astype(np.float32)
+    s_l = _scatter_xla(jnp.asarray(d), slots, None, S, K, 1)
+    s_p = _scatter_xla(jnp.asarray(d), slots, None, S, K, 8)
+    np.testing.assert_allclose(
+        unpack_table(np.asarray(s_p), K), np.asarray(s_l), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_table_rows_layout_blind():
+    rng = np.random.default_rng(2)
+    t = rng.standard_normal((S, K)).astype(np.float32)
+    slots = jnp.asarray(rng.integers(0, S, (B, F)).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(table_rows(jnp.asarray(t), slots, K)),
+        np.asarray(table_rows(jnp.asarray(pack_table(t)), slots, K)),
+    )
+
+
+@pytest.mark.parametrize("model_name", ["fm", "mvm"])
+def test_training_equality_packed_vs_logical(model_name):
+    """Full FTRL training through the sorted path ends at the same
+    logical tables from either storage layout (states initialized from
+    the SAME logical values; init RNG streams differ between layouts)."""
+    from xflow_tpu.train.state import TrainState
+
+    k = 3
+    over = {
+        "model.name": model_name,
+        "model.num_fields": F,
+        "model.v_dim": k,
+        "data.log2_slots": LOG2,
+        "data.batch_size": B,
+        "data.max_nnz": F,
+    }
+    cfg_p = override(Config(), **over)
+    cfg_l = override(Config(), **{**over, "data.packed_tables": "off"})
+    model, opt = get_model(model_name), get_optimizer("ftrl")
+    state_l = init_state(model, opt, cfg_l)
+    # pack the SAME logical values into the packed state
+    state_p = TrainState(
+        tables={n: jnp.asarray(pack_table(np.asarray(t)))
+                for n, t in state_l.tables.items()},
+        opt_state={
+            n: {kk: jnp.asarray(pack_table(np.asarray(v)))
+                for kk, v in st.items()}
+            for n, st in state_l.opt_state.items()
+        },
+        step=jnp.array(state_l.step),  # own copy: both steps donate their state
+    )
+    rng = np.random.default_rng(3)
+    step_p = make_train_step(model, opt, cfg_p)
+    step_l = make_train_step(model, opt, cfg_l)
+    for _ in range(3):
+        slots = rng.integers(0, S, (B, F)).astype(np.int32)
+        fields = np.broadcast_to(np.arange(F, dtype=np.int32), (B, F)).copy()
+        mask = (rng.random((B, F)) < 0.9).astype(np.float32)
+        plan = plan_sorted_batch(slots, mask, S)
+        batch = {
+            "sorted_slots": jnp.asarray(plan.sorted_slots),
+            "sorted_row": jnp.asarray(plan.sorted_row),
+            "sorted_mask": jnp.asarray(plan.sorted_mask),
+            "win_off": jnp.asarray(plan.win_off),
+            "labels": jnp.asarray((rng.random(B) < 0.4).astype(np.float32)),
+            "row_mask": jnp.ones((B,), jnp.float32),
+        }
+        if model_name == "mvm":
+            pass  # product path: no sorted_fields needed
+        state_p, m_p = step_p(state_p, batch)
+        state_l, m_l = step_l(state_l, batch)
+        assert float(m_p["loss"]) == pytest.approx(float(m_l["loss"]), rel=1e-6)
+    for n in state_l.tables:
+        K_n = state_l.tables[n].shape[-1]
+        np.testing.assert_allclose(
+            unpack_table(np.asarray(state_p.tables[n]), K_n),
+            np.asarray(state_l.tables[n]),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
+def test_checkpoint_cross_layout_migration(tmp_path):
+    """npz checkpoints store the LOGICAL layout; a packed run restores a
+    logical checkpoint (and vice versa) via the reshape shim."""
+    from xflow_tpu.train import checkpoint as ckpt
+
+    over = {
+        "model.name": "fm",
+        "model.v_dim": 3,
+        "data.log2_slots": LOG2,
+    }
+    cfg_p = override(Config(), **over)
+    cfg_l = override(Config(), **{**over, "data.packed_tables": "off"})
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+    state_p = init_state(model, opt, cfg_p)
+    widths = {"wv": 4}
+    path = ckpt.save(str(tmp_path / "c"), state_p, widths)
+    stored = np.load(path + "/state.npz")
+    assert stored["tables/wv"].shape == (S, 4)  # logical on disk
+    # restore into a LOGICAL-layout run
+    state_l = ckpt.restore(str(tmp_path / "c"), init_state(model, opt, cfg_l))
+    np.testing.assert_array_equal(
+        np.asarray(state_l.tables["wv"]),
+        unpack_table(np.asarray(state_p.tables["wv"]), 4),
+    )
+    # and back into a PACKED-layout run
+    state_p2 = ckpt.restore(str(tmp_path / "c"), init_state(model, opt, cfg_p))
+    np.testing.assert_array_equal(
+        np.asarray(state_p2.tables["wv"]), np.asarray(state_p.tables["wv"])
+    )

@@ -112,11 +112,13 @@ def test_fullshard_no_replication():
     arrays = [state.tables["wv"], state.opt_state["wv"]["n"], state.opt_state["wv"]["z"]]
     for arr in arrays:
         shapes = {s.data.shape for s in arr.addressable_shards}
-        assert shapes == {(S // 8, K)}, shapes
+        # packed storage: each of the 8 devices owns S/8 slots = S/8/8
+        # stored rows of 8*K (ops/sorted_table.pack_table)
+        assert shapes == {(S // 8 // 8, 8 * K)}, shapes
         # 8 distinct shards — the whole array exists exactly once
         assert len(arr.addressable_shards) == 8
         starts = sorted(s.index[0].start or 0 for s in arr.addressable_shards)
-        assert starts == [i * (S // 8) for i in range(8)]
+        assert starts == [i * (S // 8 // 8) for i in range(8)]
 
 
 def test_fullshard_capacity_overflow_raises():

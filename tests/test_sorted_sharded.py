@@ -103,8 +103,9 @@ def test_sharded_sorted_step_matches_single_device(d, t):
         rtol=1e-4, atol=1e-7,
     )
     # placement: the wv table is split on slot over 'table' only
+    # (stored rows: packed layout holds 8 slots per row)
     shard_rows = {sh.data.shape[0] for sh in s_sh.tables["wv"].addressable_shards}
-    assert shard_rows == {cfg.num_slots // t}
+    assert shard_rows == {s_sh.tables["wv"].shape[0] // t}
 
 
 def test_sharded_sorted_multi_step_trajectory():

@@ -163,6 +163,17 @@ class DataConfig:
     #   across 'data' (D× table memory; parallel/sorted_sharded.py) —
     #   fewer collectives, viable when the table fits per-device HBM.
     sorted_mesh: str = "fullshard"
+    # packed table storage (ops/sorted_table.py pack_table): vector
+    # tables live as [S/8, 8K] instead of [S, K]. TPU HBM buffers are
+    # (8, 128)-tiled, so a logical [S, 11] f32 table is STORED [S, 128]
+    # — 11.6x its logical bytes (at 2^24 slots the FM FTRL state alone
+    # is 3 x 8 GB and cannot fit one chip) and every elementwise
+    # optimizer pass runs at 11/128 lane efficiency. Packed: 1.45x
+    # padding and 88/128-lane FTRL. "auto" (default) packs whenever
+    # num_slots % 8 == 0; "off" keeps logical [S, K] storage. Layout is
+    # detected FROM THE SHAPE everywhere (pack_of), so hand-built
+    # logical tables and old checkpoints keep working.
+    packed_tables: str = "auto"
     # per-(source shard, owner block) occurrence buffer capacity, as a
     # multiple of the uniform-hash expectation Np/(D*T). Salted hashing
     # spreads slots near-uniformly, but a single hot feature's

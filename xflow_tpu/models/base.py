@@ -59,21 +59,41 @@ def init_tables(model: Model, cfg: Config, key: jax.Array) -> Dict[str, jax.Arra
     (g=0 ∧ n=0 keeps w, see `optim/ftrl.py:_update_one`) and SGD with
     g=0 is a no-op.
     """
+    from xflow_tpu.ops.sorted_table import PACK
+
+    # packed [S/8, 8K] storage for vector tables (pack_table docstring:
+    # the (8,128) HBM tiling makes logical [S, 11] storage 11.6x its
+    # bytes). Created DIRECTLY in packed shape — building [S, K] first
+    # and reshaping would materialize the padded buffer this exists to
+    # avoid. The init distribution is elementwise iid, so the packed
+    # init is distribution-identical (not bitwise: the RNG->element map
+    # differs between layouts).
+    mode = cfg.data.packed_tables
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"data.packed_tables={mode!r}: expected auto|on|off")
+    if mode == "on" and cfg.num_slots % PACK != 0:
+        raise ValueError(
+            f"data.packed_tables=on needs num_slots divisible by {PACK}; "
+            f"got 2^{cfg.data.log2_slots}"
+        )
+    pack = PACK if mode != "off" and cfg.num_slots % PACK == 0 else 1
     tables = {}
     specs = model.table_specs(cfg)
     for tname, trailing in sorted(specs.items()):
-        shape = (cfg.num_slots,) + trailing
         if trailing == ():
-            tables[tname] = jnp.zeros(shape, dtype=jnp.float32)
+            tables[tname] = jnp.zeros((cfg.num_slots,), dtype=jnp.float32)
+            continue
+        K = trailing[0]
+        shape = (cfg.num_slots // pack, pack * K)
+        key, sub = jax.random.split(key)
+        if cfg.optim.name == "sgd":
+            t = jnp.full(shape, cfg.optim.v_init_sgd, dtype=jnp.float32)
         else:
-            key, sub = jax.random.split(key)
-            if cfg.optim.name == "sgd":
-                t = jnp.full(shape, cfg.optim.v_init_sgd, dtype=jnp.float32)
-            else:
-                t = jax.random.normal(sub, shape, dtype=jnp.float32) * cfg.optim.v_init_scale
-            if tname == "wv":
-                # fused FM layout: column 0 is the linear w (zero-init like
-                # a scalar w-table), columns 1.. are the latent v
-                t = t.at[:, 0].set(0.0)
-            tables[tname] = t
+            t = jax.random.normal(sub, shape, dtype=jnp.float32) * cfg.optim.v_init_scale
+        if tname == "wv":
+            # fused FM layout: logical column 0 is the linear w (zero-init
+            # like a scalar w-table) — every pack*K-row position j with
+            # j % K == 0 in packed storage
+            t = t.at[:, ::K].set(0.0) if pack > 1 else t.at[:, 0].set(0.0)
+        tables[tname] = t
     return tables

@@ -89,11 +89,15 @@ def fm_logits_from_sums(sums, K, cfg):
 
 
 def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows, cfg):
-    from xflow_tpu.ops.sorted_table import row_sums_sorted, table_gather_sorted
+    from xflow_tpu.ops.sorted_table import (
+        pack_of,
+        row_sums_sorted,
+        table_gather_sorted,
+    )
 
-    K = wv.shape[1]
+    K = 1 + cfg.model.v_dim  # logical row width (storage may be packed)
     occ_t = table_gather_sorted(
-        wv, sorted_slots, win_off, cfg.data.sorted_bf16
+        wv, sorted_slots, win_off, cfg.data.sorted_bf16, pack_of(wv, K)
     )  # [K8, Np]
     # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
     occm_t = occ_t[:K] * sorted_mask[None, :]
@@ -124,17 +128,20 @@ def _forward_sorted(tables, batch, cfg):
 def forward(tables, batch, cfg):
     if "sorted_slots" in batch and "wv" in tables:
         return _forward_sorted(tables, batch, cfg)
+    from xflow_tpu.ops.sorted_table import table_rows
+
     mask = batch["mask"]
     if "wv" in tables:
-        # fused: ONE row gather for w and v (and one scatter in backward)
-        wvg = tables["wv"][batch["slots"]]  # [B, F, 1+k]
+        # fused: ONE row gather for w and v (and one scatter in backward);
+        # table_rows is layout-blind (logical or packed storage)
+        wvg = table_rows(tables["wv"], batch["slots"], 1 + cfg.model.v_dim)
         wx = (wvg[..., 0] * mask).sum(axis=-1)
         vg = wvg[..., 1:] * mask[..., None]
     else:
         w, v = tables["w"], tables["v"]
         wg = w[batch["slots"]]  # [B, F]
         wx = (wg * mask).sum(axis=-1)
-        vg = v[batch["slots"]] * mask[..., None]  # [B, F, k]
+        vg = table_rows(v, batch["slots"], cfg.model.v_dim) * mask[..., None]
     return wx + _second_order(vg, cfg)
 
 

@@ -200,14 +200,16 @@ def make_row_products(reduce_rows, broadcast_rows, k: int):
 
 
 def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
-                        win_off, rows, nf, bf16=False, plus=0.0):
+                        win_off, rows, nf, k, bf16=False, plus=0.0):
     """One sub-batch: [K8, Np] windowed gather + one segment-sum keyed on
-    `row * nf + field` → logits [rows]."""
-    from xflow_tpu.ops.sorted_table import table_gather_sorted
+    `row * nf + field` → logits [rows]. `k` is the LOGICAL latent dim
+    (storage may be packed, ops/sorted_table.pack_table)."""
+    from xflow_tpu.ops.sorted_table import pack_of, table_gather_sorted
 
-    k = v.shape[1]
     seg = sorted_row * nf + sorted_fields  # [Np]
-    occ_t = table_gather_sorted(v, sorted_slots, win_off, bf16)  # [K8, Np]
+    occ_t = table_gather_sorted(
+        v, sorted_slots, win_off, bf16, pack_of(v, k)
+    )  # [K8, Np]
     occm_t = occ_t[:k] * sorted_mask[None, :]
     # stack the mask as one extra channel: its segment-sum is the
     # per-(row, field) occurrence count, giving `present` in the same op
@@ -222,14 +224,20 @@ def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
 
 
 def _forward_sorted_product_one(v, sorted_slots, sorted_row, sorted_mask,
-                                win_off, rows, bf16=False, plus=0.0):
+                                win_off, rows, k, bf16=False, plus=0.0):
     """One sub-batch on the exclusive-fields product path: windowed
     gather + the SAME [rows, ~32] row-sum kernel FM uses — no
-    per-(row, field) segment space exists at all."""
-    from xflow_tpu.ops.sorted_table import row_sums_sorted, table_gather_sorted
+    per-(row, field) segment space exists at all. `k` = logical latent
+    dim (storage may be packed)."""
+    from xflow_tpu.ops.sorted_table import (
+        pack_of,
+        row_sums_sorted,
+        table_gather_sorted,
+    )
 
-    k = v.shape[1]
-    occ_t = table_gather_sorted(v, sorted_slots, win_off, bf16)  # [K8, Np]
+    occ_t = table_gather_sorted(
+        v, sorted_slots, win_off, bf16, pack_of(v, k)
+    )  # [K8, Np]
     op = make_row_products(
         lambda stacked, rows_: row_sums_sorted(stacked, rows_, rows),
         lambda arr: arr,
@@ -264,10 +272,11 @@ def _forward_sorted(tables, batch, cfg):
     v = tables["v"]
     bf16 = cfg.data.sorted_bf16
     plus = 1.0 if cfg.model.mvm_plus_one else 0.0
+    k = cfg.model.v_dim
     if "sorted_fields" not in batch:
         return map_sub_batches(
             lambda ss, sr, sm, wo, rows: _forward_sorted_product_one(
-                v, ss, sr, sm, wo, rows, bf16, plus
+                v, ss, sr, sm, wo, rows, k, bf16, plus
             ),
             batch,
             ("sorted_slots", "sorted_row", "sorted_mask", "win_off"),
@@ -276,7 +285,7 @@ def _forward_sorted(tables, batch, cfg):
     nf = cfg.model.num_fields
     return map_sub_batches(
         lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(
-            v, ss, sr, sm, sf, wo, rows, nf, bf16, plus
+            v, ss, sr, sm, sf, wo, rows, nf, k, bf16, plus
         ),
         batch,
         ("sorted_slots", "sorted_row", "sorted_mask", "sorted_fields", "win_off"),
@@ -287,10 +296,12 @@ def _forward_sorted(tables, batch, cfg):
 def forward(tables, batch, cfg):
     if "sorted_slots" in batch:
         return _forward_sorted(tables, batch, cfg)
+    from xflow_tpu.ops.sorted_table import table_rows
+
     v = tables["v"]
     nf = cfg.model.num_fields
     mask = batch["mask"]
-    vg = v[batch["slots"]] * mask[..., None]  # [B, F, k]
+    vg = table_rows(v, batch["slots"], cfg.model.v_dim) * mask[..., None]
     onehot = (batch["fields"][..., None] == jnp.arange(nf)) * mask[..., None]  # [B, F, nf]
     # full-precision einsum: the contraction is tiny (F × nf × k) and the
     # downstream product-of-fields amplifies any bf16 rounding

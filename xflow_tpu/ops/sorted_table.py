@@ -53,6 +53,65 @@ def _k8(k: int) -> int:
     return max(8, ((k + 7) // 8) * 8)
 
 
+PACK = 8  # slots per packed table row (see pack_table)
+
+
+def pack_table(t):
+    """[S, K] logical table -> [S/PACK, PACK*K] packed storage (a pure
+    reshape: slot s lives at [s // PACK, (s % PACK)*K : (s % PACK+1)*K]).
+
+    WHY: TPU HBM buffers are (8, 128)-tiled, so a [S, 11] f32 array is
+    stored [S, 128] — 11.6× its logical bytes (at 2^24 slots the FM FTRL
+    state alone is 3 × 8 GB and cannot fit a v5e chip) — and every
+    elementwise optimizer pass runs at 11/128 lane efficiency
+    (docs/PERF.md microbench: the FTRL update was the dominant FM step
+    cost for exactly this reason). Packed, the minor dim is PACK*K
+    (88 for the fused FM table): 1.45× padding instead of 11.6×, and
+    the FTRL update runs 88/128 of peak.
+
+    Consumers detect the layout FROM THE SHAPE (`pack_of`), so
+    hand-built logical tables keep working everywhere."""
+    S, K = t.shape
+    assert S % PACK == 0, (S, PACK)
+    return t.reshape(S // PACK, PACK * K)
+
+
+def unpack_table(t_packed, K: int):
+    """Inverse of pack_table. On a TPU DEVICE this materializes the
+    11.6×-padded logical buffer — call on host arrays (free reshape) or
+    small tables only."""
+    Sp, PK = t_packed.shape
+    assert PK % K == 0, (PK, K)
+    return t_packed.reshape(Sp * (PK // K), K)
+
+
+def table_rows(table, slots, K: int):
+    """Logical rows ``table[slots]`` from EITHER storage layout — the
+    row-major paths' (GSPMD step, mesh eval, non-sorted forwards)
+    layout-blind gather. Packed: one row gather of [..., pack*K] plus an
+    elementwise 0/1 sub-row select (never a matmul, so no MXU operand
+    rounding — see `_sub_select`)."""
+    pack = pack_of(table, K)
+    if pack == 1:
+        return table[slots]
+    rows = table[slots // pack]
+    return _sub_select(rows, slots % pack, pack, K)
+
+
+def pack_of(table, K: int) -> int:
+    """Storage layout of `table` given its LOGICAL row width K: 1 =
+    logical [S, K], PACK = packed [S/PACK, PACK*K]. Raises on anything
+    else (a shape mismatch here means a config/table disagreement)."""
+    if table.ndim != 2 or table.shape[1] == K:
+        return 1
+    if table.shape[1] == PACK * K:
+        return PACK
+    raise ValueError(
+        f"table shape {table.shape} is neither logical [S, {K}] nor "
+        f"packed [S/{PACK}, {PACK * K}]"
+    )
+
+
 class SortedPlan(NamedTuple):
     """Host-computed sorted layout of one batch's feature occurrences.
 
@@ -310,18 +369,39 @@ def resolve_sub_batches(cfg) -> int:
 
 # ------------------------------------------------------------------ XLA path
 
-def _gather_xla(table, sorted_slots, win_off):
-    S, K = table.shape
+def _sub_select(rows, sub, pack: int, K: int):
+    """[..., pack*K] packed rows -> [..., K] logical rows selected by
+    `sub` in [0, pack). Elementwise multiply-sum on 0/1 masks — NEVER a
+    matmul, so no MXU operand rounding can touch the values."""
+    sel = (sub[..., None] == jnp.arange(pack)).astype(rows.dtype)  # [..., pack]
+    grouped = rows.reshape(*rows.shape[:-1], pack, K)
+    return (grouped * sel[..., None]).sum(axis=-2)
+
+
+def _gather_xla(table, sorted_slots, win_off, pack: int = 1):
+    Sp, W = table.shape
+    S, K = Sp * pack, W // pack
     safe = jnp.minimum(sorted_slots, S - 1)
-    occ = jnp.where((sorted_slots < S)[:, None], table[safe], 0.0)  # [Np, K]
+    if pack == 1:
+        occ = jnp.where((sorted_slots < S)[:, None], table[safe], 0.0)  # [Np, K]
+    else:
+        rows = jnp.where(
+            (sorted_slots < S)[:, None], table[safe // pack], 0.0
+        )  # [Np, pack*K]
+        occ = _sub_select(rows, safe % pack, pack, K)
     out = jnp.zeros((_k8(K), sorted_slots.shape[0]), table.dtype)
     return jax.lax.dynamic_update_slice(out, occ.T, (0, 0))
 
 
-def _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k: int):
+def _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k: int, pack: int = 1):
     safe = jnp.minimum(sorted_slots, num_slots - 1)
     d = jnp.where((sorted_slots < num_slots)[None, :], d_occ_t[:k], 0.0)
-    return jax.ops.segment_sum(d.T, safe, num_segments=num_slots)
+    if pack == 1:
+        return jax.ops.segment_sum(d.T, safe, num_segments=num_slots)
+    sub = safe % pack
+    sel = (sub[:, None] == jnp.arange(pack)).astype(d.dtype)  # [Np, pack]
+    d_exp = (d.T[:, None, :] * sel[:, :, None]).reshape(-1, pack * k)
+    return jax.ops.segment_sum(d_exp, safe // pack, num_segments=num_slots // pack)
 
 
 # --------------------------------------------------------------- Pallas path
@@ -369,8 +449,36 @@ def _dot_f32(a, onehot_f32, dims, bf16: bool):
     k = a.shape[free]
     return (out[:k] + out[k : 2 * k]) + out[2 * k :]
 
+def _windowed_select(table_block, rel, pack: int, bf16: bool):
+    """One window's per-occurrence table rows via the one-hot MXU
+    contraction, in logical ([W, K] block, pack=1) or packed
+    ([W/pack, pack*K] block) layout. Packed does the one-hot over
+    PACKED rows (pack× narrower iota/compare and pack× shorter MXU
+    contraction) and then selects the sub-row with `pack` STATIC
+    slice-multiply-adds — 0/1 masks, elementwise, exact. Returns
+    occ [K, C]."""
+    if pack == 1:
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (table_block.shape[0], rel.shape[1]), 0)
+            == rel
+        ).astype(jnp.float32)
+        return _dot_f32(table_block, onehot, (((0,), (0,)), ((), ())), bf16)
+    Wp = table_block.shape[0]
+    K = table_block.shape[1] // pack
+    rel_p = rel // pack  # floor semantics also for out-of-window negatives
+    onehot_p = (
+        jax.lax.broadcasted_iota(jnp.int32, (Wp, rel.shape[1]), 0) == rel_p
+    ).astype(jnp.float32)
+    occ_p = _dot_f32(table_block, onehot_p, (((0,), (0,)), ((), ())), bf16)  # [pack*K, C]
+    sub = rel - rel_p * pack  # [1, C]; out-of-window chunks have no onehot hit
+    occ = occ_p[0:K, :] * (sub == 0)
+    for p in range(1, pack):
+        occ = occ + occ_p[p * K : (p + 1) * K, :] * (sub == p)
+    return occ
+
+
 def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_d,
-                   sem_o, *, bf16, n_tw):
+                   sem_o, *, bf16, n_tw, pack):
     """Triple-buffered windowed gather: the chunk chain is DMA-LATENCY
     bound, not bandwidth bound (~460 MB of traffic measured ~18 ms
     serialized = ~4 us/chunk of waits), so inputs for chunk c+2 prefetch
@@ -385,7 +493,7 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_
     from jax.experimental.pallas import tpu as pltpu
 
     t = pl.program_id(0)
-    K = table_ref.shape[1]
+    K = table_ref.shape[1] // pack
     # t % n_tw: the grid may sweep the table's windows SEVERAL times (the
     # fully-sharded engine concatenates D per-source-shard occurrence
     # buffers that each span the same local table shard); in the
@@ -432,17 +540,12 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_
         cs, co = in_copies(c)
         cs.wait()
         rel = slc[sel][0:1, :] - base  # [1, C]
-        onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, (WINDOW, CHUNK), 0) == rel
-        ).astype(jnp.float32)  # [W, C]
         # f32-accurate selection via the stacked 3-term bf16 contraction
         # (_dot_f32): the MXU's default bf16 pass would round every
         # gathered table value to 8 mantissa bits (caught by an on-device
         # parity check vs the XLA gather, ~2^-8 rel error — CPU tests are
         # f32-exact and cannot see it)
-        occ = _dot_f32(
-            table_ref[:, :], onehot, (((0,), (0,)), ((), ())), bf16
-        )  # [K, C]
+        occ = _windowed_select(table_ref[:, :], rel, pack, bf16)  # [K, C]
         co.wait()
         in_win = (rel >= 0) & (rel < WINDOW)  # [1, C]
         # blend: positions whose slot is outside this window belong to a
@@ -482,13 +585,14 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_
         out_copy(n_chunks - 1).wait()
 
 
-def _gather_pallas(table, sorted_slots, win_off, bf16=False):
+def _gather_pallas(table, sorted_slots, win_off, bf16=False, pack=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    S, K = table.shape
+    Sp, Kp = table.shape
+    K = Kp // pack
     K8 = _k8(K)
-    n_tw = S // WINDOW
+    n_tw = Sp * pack // WINDOW
     # grid = logical windows = len(win_off)-1; a multiple of n_tw when the
     # occurrence stream is D concatenated buffers over the same table
     n_win = win_off.shape[0] - 1
@@ -498,7 +602,7 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False):
         grid=(n_win,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
-            pl.BlockSpec((WINDOW, K), lambda t, off: (t % n_tw, 0)),  # table window
+            pl.BlockSpec((WINDOW // pack, Kp), lambda t, off: (t % n_tw, 0)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),  # occ_t [K8, Np]
         scratch_shapes=[
@@ -510,7 +614,7 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False):
         ],
     )
     return pl.pallas_call(
-        partial(_gather_kernel, bf16=bf16, n_tw=n_tw),
+        partial(_gather_kernel, bf16=bf16, n_tw=n_tw, pack=pack),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((K8, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
@@ -518,13 +622,16 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False):
 
 
 def _scatter_span(slots_ref, d_ref, slc, dch, sem_s, sem_d, base, start, end,
-                  acc_t, bf16):
+                  acc_t, bf16, pack=1, k=None):
     """Accumulate one occurrence span's contribution to the window at
-    `base` into acc_t [K8, W] — the precision-critical DMA + one-hot +
-    `_dot_f32` sequence shared by the single-stream and multi-buffer
-    scatter kernels (a fix here fixes both). Triple-buffered: chunk
-    c+2's inputs prefetch during compute of c (the chain is DMA-latency
-    bound, like the gather's)."""
+    `base` into acc_t ([K8, W] logical, [pack*K, W/pack] packed) — the
+    precision-critical DMA + one-hot + `_dot_f32` sequence shared by
+    the single-stream and multi-buffer scatter kernels (a fix here
+    fixes both). Packed expands the [K, C] cotangent chunk to
+    [pack*K, C] with `pack` static 0/1-masked block copies (exact) and
+    contracts against the PACKED one-hot — pack× fewer MXU MACs per
+    chunk. Triple-buffered: chunk c+2's inputs prefetch during compute
+    of c (the chain is DMA-latency bound, like the gather's)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -567,33 +674,46 @@ def _scatter_span(slots_ref, d_ref, slc, dch, sem_s, sem_d, base, start, end,
             start_in(c + 2)
 
         rel = slc[sel][0:1, :] - base  # [1, C]; out-of-window: no lane
-        onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, (WINDOW, CHUNK), 0) == rel
-        ).astype(jnp.float32)  # [W, C]
-        # [K8, C] x [W, C] contracting C -> [K8, W]
-        # f32-accurate for the same reason as the gather; duplicate slots
-        # in a chunk make this a SUM, so vs XLA's scatter only the f32
-        # accumulation order differs (<= 1 ulp/add — see _dot_f32)
-        return acc + _dot_f32(dch[sel], onehot, (((1,), (1,)), ((), ())), bf16)
+        if pack == 1:
+            onehot = (
+                jax.lax.broadcasted_iota(jnp.int32, (WINDOW, CHUNK), 0) == rel
+            ).astype(jnp.float32)  # [W, C]
+            # [K8, C] x [W, C] contracting C -> [K8, W]
+            # f32-accurate for the same reason as the gather; duplicate
+            # slots in a chunk make this a SUM, so vs XLA's scatter only
+            # the f32 accumulation order differs (<= 1 ulp/add, _dot_f32)
+            return acc + _dot_f32(dch[sel], onehot, (((1,), (1,)), ((), ())), bf16)
+        rel_p = rel // pack
+        onehot_p = (
+            jax.lax.broadcasted_iota(jnp.int32, (WINDOW // pack, CHUNK), 0) == rel_p
+        ).astype(jnp.float32)  # [W/pack, C]
+        sub = rel - rel_p * pack
+        d_exp = jnp.concatenate(
+            [dch[sel][0:k, :] * (sub == p) for p in range(pack)], axis=0
+        )  # [pack*K, C]
+        return acc + _dot_f32(d_exp, onehot_p, (((1,), (1,)), ((), ())), bf16)
 
     return jax.lax.fori_loop(0, n_chunks, chunk_step, acc_t)
 
 
-def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d, *, bf16):
+def _scatter_kernel(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d,
+                    *, bf16, pack):
     from jax.experimental import pallas as pl
 
     t = pl.program_id(0)
     K8 = d_ref.shape[0]
-    K = out_ref.shape[1]
-    acc_t = jnp.zeros((K8, WINDOW), jnp.float32)
+    K = out_ref.shape[1] // pack
+    rows = pack * K if pack > 1 else K8
+    acc_t = jnp.zeros((rows, WINDOW // pack), jnp.float32)
     acc_t = _scatter_span(
         slots_ref, d_ref, slc, dch, sem_s, sem_d,
-        t * WINDOW, off_ref[t], off_ref[t + 1], acc_t, bf16,
+        t * WINDOW, off_ref[t], off_ref[t + 1], acc_t, bf16, pack, K,
     )
-    out_ref[:, :] = acc_t[0:K, :].T  # [W, K]
+    out_ref[:, :] = (acc_t if pack > 1 else acc_t[0:K, :]).T  # [W/pack, pack*K]
 
 
-def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=False):
+def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=False,
+                    pack=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -606,7 +726,7 @@ def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=Fals
             pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
             pl.BlockSpec(memory_space=pl.ANY),  # d [K8, Np]
         ],
-        out_specs=pl.BlockSpec((WINDOW, k), lambda t, off: (t, 0)),
+        out_specs=pl.BlockSpec((WINDOW // pack, pack * k), lambda t, off: (t, 0)),
         scratch_shapes=[
             pltpu.VMEM((3, 1, CHUNK), jnp.int32),
             pltpu.VMEM((3, K8, CHUNK), jnp.float32),
@@ -615,14 +735,14 @@ def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=Fals
         ],
     )
     return pl.pallas_call(
-        partial(_scatter_kernel, bf16=bf16),
+        partial(_scatter_kernel, bf16=bf16, pack=pack),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_slots, k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_slots // pack, pack * k), jnp.float32),
     )(win_off, sorted_slots.reshape(1, n), d_occ_t)
 
 
 def _scatter_kernel_multi(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, sem_d,
-                          *, bf16, nbuf, cap):
+                          *, bf16, nbuf, cap, pack):
     """Windowed scatter over `nbuf` concatenated per-source buffers.
 
     The fully-sharded engine's cotangent stream is nbuf buffers of `cap`
@@ -636,22 +756,24 @@ def _scatter_kernel_multi(off_ref, slots_ref, d_ref, out_ref, slc, dch, sem_s, s
 
     j = pl.program_id(0)
     K8 = d_ref.shape[0]
-    K = out_ref.shape[1]
+    K = out_ref.shape[1] // pack
 
     def buf_step(i, acc_t):
         # aligned-down reads stay >= i*cap (cap % CHUNK == 0)
         return _scatter_span(
             slots_ref, d_ref, slc, dch, sem_s, sem_d,
             j * WINDOW, i * cap + off_ref[i, j], i * cap + off_ref[i, j + 1],
-            acc_t, bf16,
+            acc_t, bf16, pack, K,
         )
 
-    acc_t = jnp.zeros((K8, WINDOW), jnp.float32)
+    rows = pack * K if pack > 1 else K8
+    acc_t = jnp.zeros((rows, WINDOW // pack), jnp.float32)
     acc_t = jax.lax.fori_loop(0, nbuf, buf_step, acc_t)
-    out_ref[:, :] = acc_t[0:K, :].T
+    out_ref[:, :] = (acc_t if pack > 1 else acc_t[0:K, :]).T
 
 
-def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf16=False):
+def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap,
+                          bf16=False, pack=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -667,7 +789,7 @@ def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf1
             pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
             pl.BlockSpec(memory_space=pl.ANY),  # d [K8, Np]
         ],
-        out_specs=pl.BlockSpec((WINDOW, k), lambda t, off: (t, 0)),
+        out_specs=pl.BlockSpec((WINDOW // pack, pack * k), lambda t, off: (t, 0)),
         scratch_shapes=[
             pltpu.VMEM((3, 1, CHUNK), jnp.int32),
             pltpu.VMEM((3, K8, CHUNK), jnp.float32),
@@ -676,9 +798,9 @@ def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf1
         ],
     )
     return pl.pallas_call(
-        partial(_scatter_kernel_multi, bf16=bf16, nbuf=nbuf, cap=cap),
+        partial(_scatter_kernel_multi, bf16=bf16, nbuf=nbuf, cap=cap, pack=pack),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_slots, k), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_slots // pack, pack * k), jnp.float32),
     )(loc_off, sorted_slots.reshape(1, n), d_occ_t)
 
 
@@ -783,34 +905,41 @@ row_sums_sorted.defvjp(_rowsum_fwd, _rowsum_bwd)
 
 # ------------------------------------------------------------ public op
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def table_gather_sorted(table, sorted_slots, win_off, bf16=False):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def table_gather_sorted(table, sorted_slots, win_off, bf16=False, pack=1):
     """Per-occurrence table rows, transposed: [K8, Np] for slot-sorted
     occurrences. Differentiable in `table`; the VJP is the windowed
     scatter-add. Rows K..K8 are zero. Padded columns (positions past the
     batch's real occurrences) hold row `S-1`'s values, not zeros —
     multiply by `sorted_mask` before use. `bf16` (static — thread
     cfg.data.sorted_bf16 here) trades the f32-accurate 3-pass MXU
-    contraction for one rounded pass (see `_dot_f32`)."""
+    contraction for one rounded pass (see `_dot_f32`). `pack` (static;
+    callers derive it with `pack_of`) says the table is stored
+    [S/pack, pack*K] (see `pack_table`); slot indices stay LOGICAL, the
+    output is identical either way, and the VJP writes the gradient in
+    the table's own layout."""
     if _on_tpu():
-        return _gather_pallas(table, sorted_slots, win_off, bf16)
-    return _gather_xla(table, sorted_slots, win_off)
+        return _gather_pallas(table, sorted_slots, win_off, bf16, pack)
+    return _gather_xla(table, sorted_slots, win_off, pack)
 
 
-def _gather_fwd(table, sorted_slots, win_off, bf16=False):
-    return table_gather_sorted(table, sorted_slots, win_off, bf16), (
+def _gather_fwd(table, sorted_slots, win_off, bf16=False, pack=1):
+    return table_gather_sorted(table, sorted_slots, win_off, bf16, pack), (
         sorted_slots,
         win_off,
         table.shape,
     )
 
 
-def _gather_bwd(bf16, res, d_occ_t):
-    sorted_slots, win_off, (num_slots, k) = res
+def _gather_bwd(bf16, pack, res, d_occ_t):
+    sorted_slots, win_off, (rows, width) = res
+    num_slots, k = rows * pack, width // pack
     if _on_tpu():
-        d_table = _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k, bf16)
+        d_table = _scatter_pallas(
+            d_occ_t, sorted_slots, win_off, num_slots, k, bf16, pack
+        )
     else:
-        d_table = _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k)
+        d_table = _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k, pack)
     return d_table, None, None
 
 
@@ -832,8 +961,8 @@ def _multi_off_flat(loc_off, cap):
     )
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def table_gather_sorted_multi(table, sorted_slots, loc_off, bf16=False):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def table_gather_sorted_multi(table, sorted_slots, loc_off, bf16=False, pack=1):
     """`table_gather_sorted` over a concatenated multi-buffer stream: the
     fully-sharded engine's per-device input is `nbuf` fixed-capacity
     buffers (one per source data shard, each slot-sorted over THIS
@@ -845,30 +974,35 @@ def table_gather_sorted_multi(table, sorted_slots, loc_off, bf16=False):
 
     `loc_off` [nbuf, wpo+1]: buffer-local window offsets, last entry
     extended to `cap`. Capacity = sorted_slots.size // nbuf, a CHUNK
-    multiple (host contract, parallel/sorted_fullshard.py)."""
+    multiple (host contract, parallel/sorted_fullshard.py). `pack` as
+    in `table_gather_sorted` (the local shard stored [S_l/pack,
+    pack*K])."""
     if _on_tpu():
         cap = sorted_slots.shape[0] // loc_off.shape[0]
-        return _gather_pallas(table, sorted_slots, _multi_off_flat(loc_off, cap), bf16)
-    return _gather_xla(table, sorted_slots, None)
+        return _gather_pallas(
+            table, sorted_slots, _multi_off_flat(loc_off, cap), bf16, pack
+        )
+    return _gather_xla(table, sorted_slots, None, pack)
 
 
-def _gather_multi_fwd(table, sorted_slots, loc_off, bf16=False):
-    return table_gather_sorted_multi(table, sorted_slots, loc_off, bf16), (
+def _gather_multi_fwd(table, sorted_slots, loc_off, bf16=False, pack=1):
+    return table_gather_sorted_multi(table, sorted_slots, loc_off, bf16, pack), (
         sorted_slots,
         loc_off,
         table.shape,
     )
 
 
-def _gather_multi_bwd(bf16, res, d_occ_t):
-    sorted_slots, loc_off, (num_slots, k) = res
+def _gather_multi_bwd(bf16, pack, res, d_occ_t):
+    sorted_slots, loc_off, (rows, width) = res
+    num_slots, k = rows * pack, width // pack
     if _on_tpu():
         cap = sorted_slots.shape[0] // loc_off.shape[0]
         d_table = _scatter_pallas_multi(
-            d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf16
+            d_occ_t, sorted_slots, loc_off, num_slots, k, cap, bf16, pack
         )
     else:
-        d_table = _scatter_xla(d_occ_t, sorted_slots, None, num_slots, k)
+        d_table = _scatter_xla(d_occ_t, sorted_slots, None, num_slots, k, pack)
     return d_table, None, None
 
 

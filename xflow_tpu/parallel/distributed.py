@@ -36,7 +36,8 @@ def maybe_initialize(
     if process_id is None:
         pid_env = os.environ.get("XFLOW_PROCESS_ID")
         process_id = int(pid_env) if pid_env is not None else None
-    if not coordinator and os.environ.get("XFLOW_AUTO_DIST"):
+    auto = os.environ.get("XFLOW_AUTO_DIST", "").lower()
+    if not coordinator and auto not in ("", "0", "false", "no", "off"):
         # TPU pod slices (and other managed clusters) publish their own
         # topology: a no-arg initialize reads it from the runtime
         # metadata, so a pod launch needs no XFLOW_* contract at all —

@@ -279,12 +279,16 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
     nf = cfg.model.num_fields
     bf16 = cfg.data.sorted_bf16
     plus = 1.0 if cfg.model.mvm_plus_one else 0.0
+    K = cfg.model.v_dim + (0 if mvm else 1)  # LOGICAL row width
 
     def local_loss(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
                    labels, row_mask):
-        """Device (d, t) body. tbl_local [S/(D*T), K]; fs_* are MY source
-        shard's buffers for column t, [D_dst, cap]; labels [R]."""
-        K = tbl_local.shape[1]
+        """Device (d, t) body. tbl_local [S/(D*T)/pack, pack*K]; fs_* are
+        MY source shard's buffers for column t, [D_dst, cap]; labels
+        [R]. Storage may be packed (ops/sorted_table.pack_table) —
+        detected from the shard's shape, slot indices stay logical."""
+        from xflow_tpu.ops.sorted_table import pack_of
+
         R = labels.shape[0]
 
         # 2. exchange: my buffer for dest d' -> device (d', t); receive
@@ -300,7 +304,9 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
         mask_flat = jax.lax.stop_gradient(r_mask.reshape(-1))
 
         # 3. local windowed gather (+ shard-local scatter in the VJP)
-        occ_t = table_gather_sorted_multi(tbl_local, slots_flat, r_off, bf16)
+        occ_t = table_gather_sorted_multi(
+            tbl_local, slots_flat, r_off, bf16, pack_of(tbl_local, K)
+        )
         occm_t = occ_t[:K] * mask_flat[None, :]
 
         # rows arrive shard-local [0, R); globalize by source index so one

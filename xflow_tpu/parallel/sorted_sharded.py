@@ -115,9 +115,13 @@ def make_sorted_sharded_train_step(
 
     def local_loss(wv_local, sorted_slots, sorted_row, sorted_mask, win_off,
                    labels, row_mask):
-        """Per-device body. wv_local [S/T, K]; occurrence arrays are this
-        data shard's full plan [Np_l]; labels/row_mask [B/D]."""
-        K = wv_local.shape[1]
+        """Per-device body. wv_local [S/T/pack, pack*K]; occurrence
+        arrays are this data shard's full plan [Np_l]; labels/row_mask
+        [B/D]. Storage may be packed (pack_table) — detected from the
+        shard shape; slot indices stay logical."""
+        from xflow_tpu.ops.sorted_table import pack_of
+
+        K = 1 + cfg.model.v_dim
         t_idx = jax.lax.axis_index(TABLE_AXIS)
         # this shard's windows: global win_off sliced to [t*wpt, (t+1)*wpt]
         off_local = jax.lax.dynamic_slice(win_off, (t_idx * wpt,), (wpt + 1,))
@@ -127,7 +131,8 @@ def make_sorted_sharded_train_step(
         # in-span mask removes from compute
         slots_local = sorted_slots - t_idx * S_local
         occ_t = table_gather_sorted(
-            wv_local, slots_local, off_local, cfg.data.sorted_bf16
+            wv_local, slots_local, off_local, cfg.data.sorted_bf16,
+            pack_of(wv_local, K),
         )  # [K8, Np_l]
         pos = jnp.arange(sorted_slots.shape[0], dtype=jnp.int32)
         in_span = (pos >= off_local[0]) & (pos < off_local[-1])

@@ -143,6 +143,27 @@ def check_kernel_parity(
     )
     checks["scatter_multi_exact"] = _rel_err(got_ms, want_ms, floor=1e-2)
 
+    # --- packed storage ([S/8, 8K], pack_table): gather BIT-exact vs
+    # the logical-layout kernel, scatter equal to the packed logical
+    # gradient — the packed one-hot + static sub-row select must not
+    # change a single bit of what the MXU produces
+    from xflow_tpu.ops.sorted_table import pack_table, unpack_table
+
+    tbl_p = jnp.asarray(pack_table(table))
+    got_p = np.asarray(
+        jax.jit(lambda t, s, w: table_gather_sorted(t, s, w, False, 8))(tbl_p, ss, wo)
+    )
+    checks["gather_packed"] = _rel_err(got_p, got)
+
+    def scat_p(t, s, w, d):
+        _, vjp = jax.vjp(lambda tt: table_gather_sorted(tt, s, w, False, 8), t)
+        return vjp(d)[0]
+
+    got_ps = np.asarray(jax.jit(scat_p)(tbl_p, ss, wo, jnp.asarray(d_occ)))
+    checks["scatter_packed"] = _rel_err(
+        unpack_table(got_ps, k), got_s, floor=1e-2
+    )
+
     # --- row-sum kernel (the FM forward's occurrence->row reduction)
     ch = 24
     vals_t = (rng.standard_normal((ch, Np)).astype(np.float32)
@@ -168,6 +189,8 @@ def check_kernel_parity(
         # <=1e-4, while a routing bug moves O(1) mass (err >= ~1)
         "scatter_exact": 1e-4,
         "scatter_multi_exact": 1e-4,
+        "gather_packed": 0.0,
+        "scatter_packed": 1e-4,
         "rowsum": 1e-4,
     }
     ok = all(checks[name] <= tol[name] for name in tol)

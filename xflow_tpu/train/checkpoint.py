@@ -37,28 +37,41 @@ def _to_host(arr) -> np.ndarray:
     return np.asarray(arr)
 
 
-def _flatten(state: TrainState) -> dict:
+def _unpack_host(arr: np.ndarray, K: Optional[int]) -> np.ndarray:
+    """Host-side packed [S/p, p*K] -> logical [S, K] (a free reshape).
+    npz checkpoints ALWAYS store the logical layout, so export tools,
+    the C API, and runs with a different data.packed_tables setting all
+    read the same format; restore() re-packs to the target shape."""
+    if K and arr.ndim == 2 and arr.shape[1] != K and arr.shape[1] % K == 0:
+        return arr.reshape(-1, K)
+    return arr
+
+
+def _flatten(state: TrainState, logical_widths: Optional[dict] = None) -> dict:
+    widths = logical_widths or {}
     flat = {}
     for name, t in state.tables.items():
-        flat[f"tables/{name}"] = _to_host(t)
+        flat[f"tables/{name}"] = _unpack_host(_to_host(t), widths.get(name))
     for name, st in state.opt_state.items():
         for k, v in st.items():
-            flat[f"opt/{name}/{k}"] = _to_host(v)
+            flat[f"opt/{name}/{k}"] = _unpack_host(_to_host(v), widths.get(name))
     flat["step"] = _to_host(state.step)
     return flat
 
 
-def save(ckpt_dir: str, state: TrainState) -> str:
+def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None) -> str:
     """Write a checkpoint; returns its path.
 
     Host-gathered npz format: in multi-process mode every rank gathers
     (the allgather is collective) but only process 0 writes. Fine up to
     tables that fit one host's RAM; the Criteo-1TB-scale sharded format
     is Orbax-based (see OrbaxCheckpointer below when available).
+    `logical_widths` ({table: K}) unpacks packed storage so the file is
+    layout-independent (_unpack_host).
     """
     step = int(state.step)
     path = os.path.join(ckpt_dir, f"step_{step}")
-    flat = _flatten(state)  # collective: all ranks participate
+    flat = _flatten(state, logical_widths)  # collective: all ranks participate
     if jax.process_index() == 0:
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "state.npz"), **flat)
@@ -108,6 +121,10 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
                 "current default)."
             )
         arr = data[name]
+        if arr.shape != template.shape and arr.size == template.size:
+            # layout migration: logical [S, K] stored <-> packed
+            # [S/p, p*K] expected (or the reverse) is a pure reshape
+            arr = arr.reshape(template.shape)
         sharding = getattr(template, "sharding", None)
         return jax.device_put(arr, sharding) if sharding is not None else arr
 

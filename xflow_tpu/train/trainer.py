@@ -551,16 +551,29 @@ class Trainer:
         # FTRL's n accumulator (n>0 ⇔ slot was pushed) is the reliable
         # signal; untouched slots keep their build-time init, so a
         # nonzero count would read ~1.0 for randomly-initialized v tables.
+        specs = self.model.table_specs(cfg)
+
+        def slot_any(mask2d, name):
+            """Per-SLOT any over the row width — packed storage
+            ([S/pack, pack*K], ops/sorted_table.pack_table) groups pack
+            slots per stored row, and an any over the full stored row
+            would count 8-slot groups, not slots."""
+            K = specs[name][0]
+            sp, width = mask2d.shape
+            return mask2d.reshape(sp, width // K, K).any(axis=-1)
+
         for name, t in self.state.tables.items():
             st = self.state.opt_state.get(name)
             if isinstance(st, dict) and "n" in st:
-                touched = (st["n"] > 0).any(axis=-1) if st["n"].ndim > 1 else st["n"] > 0
+                touched = (
+                    slot_any(st["n"] > 0, name) if st["n"].ndim > 1 else st["n"] > 0
+                )
             else:
                 # stateless optimizer (SGD): a touched slot has moved off
                 # its build-time init (0 for scalar tables, v_init_sgd for
                 # vector tables — models/base.py init_tables)
                 init = cfg.optim.v_init_sgd if t.ndim > 1 else 0.0
-                touched = (t != init).any(axis=-1) if t.ndim > 1 else t != init
+                touched = slot_any(t != init, name) if t.ndim > 1 else t != init
             res.occupancy[name] = float(jnp.mean(touched))
         self.metrics.log({"final": True, "steps": res.steps, "occupancy": res.occupancy})
         if cfg.train.checkpoint_dir:
@@ -706,9 +719,18 @@ class Trainer:
         from xflow_tpu.train import checkpoint as ckpt
 
         if self.cfg.train.checkpoint_format == "orbax":
+            # orbax stores the device arrays in their NATIVE (possibly
+            # packed) layout, shard-parallel; npz stores the LOGICAL
+            # layout so export tools and differently-configured runs
+            # read one format
             ckpt.save_orbax(self.cfg.train.checkpoint_dir, self.state)
         else:
-            ckpt.save(self.cfg.train.checkpoint_dir, self.state)
+            widths = {
+                name: trailing[0]
+                for name, trailing in self.model.table_specs(self.cfg).items()
+                if trailing
+            }
+            ckpt.save(self.cfg.train.checkpoint_dir, self.state, widths)
 
     def maybe_restore(self) -> bool:
         from xflow_tpu.train import checkpoint as ckpt
