@@ -95,7 +95,10 @@ def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows
         table_gather_sorted,
     )
 
+    from xflow_tpu.ops.sorted_table import wire_mask, wire_rows
+
     K = 1 + cfg.model.v_dim  # logical row width (storage may be packed)
+    sorted_row, sorted_mask = wire_rows(sorted_row), wire_mask(sorted_mask)
     occ_t = table_gather_sorted(
         wv, sorted_slots, win_off, cfg.data.sorted_bf16, pack_of(wv, K)
     )  # [K8, Np]

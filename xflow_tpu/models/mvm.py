@@ -204,9 +204,15 @@ def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
     """One sub-batch: [K8, Np] windowed gather + one segment-sum keyed on
     `row * nf + field` → logits [rows]. `k` is the LOGICAL latent dim
     (storage may be packed, ops/sorted_table.pack_table)."""
-    from xflow_tpu.ops.sorted_table import pack_of, table_gather_sorted
+    from xflow_tpu.ops.sorted_table import (
+        pack_of,
+        table_gather_sorted,
+        wire_mask,
+        wire_rows,
+    )
 
-    seg = sorted_row * nf + sorted_fields  # [Np]
+    sorted_row, sorted_mask = wire_rows(sorted_row), wire_mask(sorted_mask)
+    seg = sorted_row * nf + wire_rows(sorted_fields)  # [Np]
     occ_t = table_gather_sorted(
         v, sorted_slots, win_off, bf16, pack_of(v, k)
     )  # [K8, Np]
@@ -233,8 +239,11 @@ def _forward_sorted_product_one(v, sorted_slots, sorted_row, sorted_mask,
         pack_of,
         row_sums_sorted,
         table_gather_sorted,
+        wire_mask,
+        wire_rows,
     )
 
+    sorted_row, sorted_mask = wire_rows(sorted_row), wire_mask(sorted_mask)
     occ_t = table_gather_sorted(
         v, sorted_slots, win_off, bf16, pack_of(v, k)
     )  # [K8, Np]

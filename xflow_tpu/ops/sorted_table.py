@@ -85,6 +85,57 @@ def unpack_table(t_packed, K: int):
     return t_packed.reshape(Sp * (PK // K), K)
 
 
+def compact_plan_wire(arrays: dict, rows_bound: int, fields_bound: int = 0) -> dict:
+    """Shrink the per-batch plan arrays' host->device wire format:
+    row ids to uint16, fields to uint8, the 0/1 mask to uint8 —
+    14.2 -> 8.2 MB per 64k x 18 batch (plus ~3.5 MB on the MVM segment
+    path's fields), ~45% less PCIe (or tunnel) traffic per step. The
+    jitted forwards upcast on device (`wire_rows` / `wire_mask`), where
+    the cast fuses for free.
+
+    Every decision here is made from CONFIG-DERIVED BOUNDS (`rows_bound`
+    = rows per sub-batch/shard, `fields_bound` = model.num_fields), NOT
+    from the data: in multi-process SPMD each rank compacts its own
+    batch, the dtypes are baked into the jitted collective program, and
+    a value-dependent choice could differ across ranks and desync the
+    all_to_all sequences. The mask is guaranteed 0/1 by the data
+    pipeline (parser/pad contract); a fractional mask from a custom
+    caller is a bug and raises loudly rather than silently changing the
+    wire format."""
+    out = dict(arrays)
+    if rows_bound <= (1 << 16):
+        for key in ("sorted_row", "fs_row"):
+            if key in out and np.asarray(out[key]).dtype == np.int32:
+                out[key] = np.asarray(out[key]).astype(np.uint16)
+    if 0 < fields_bound <= (1 << 8):
+        for key in ("sorted_fields", "fs_fields"):
+            if key in out and np.asarray(out[key]).dtype == np.int32:
+                out[key] = np.asarray(out[key]).astype(np.uint8)
+    for key in ("sorted_mask", "fs_mask"):
+        if key in out:
+            m = np.asarray(out[key])
+            if m.dtype == np.float32:
+                u8 = m.astype(np.uint8)
+                if not (m == u8).all():
+                    raise ValueError(
+                        f"{key} carries non-0/1 values: the mask is a presence "
+                        "mask by the batch-schema contract (data/schema.py); "
+                        "fractional values here are a pipeline bug"
+                    )
+                out[key] = u8
+    return out
+
+
+def wire_rows(sorted_row):
+    """Device-side upcast of a possibly-compacted row-id array."""
+    return sorted_row.astype(jnp.int32)
+
+
+def wire_mask(sorted_mask):
+    """Device-side upcast of a possibly-compacted mask array."""
+    return sorted_mask.astype(jnp.float32)
+
+
 def table_rows(table, slots, K: int):
     """Logical rows ``table[slots]`` from EITHER storage layout — the
     row-major paths' (GSPMD step, mesh eval, non-sorted forwards)

@@ -296,9 +296,13 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
         def a2a(x):
             return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
 
+        from xflow_tpu.ops.sorted_table import wire_mask, wire_rows
+
         r_slots = a2a(fs_slots)  # [D_src, cap]
-        r_row = a2a(fs_row)
-        r_mask = a2a(fs_mask)
+        # compacted wire dtypes (compact_plan_wire) ride through the
+        # all_to_all — less ICI traffic too — and upcast after
+        r_row = wire_rows(a2a(fs_row))
+        r_mask = wire_mask(a2a(fs_mask))
         r_off = a2a(fs_off)  # [D_src, wpo+1]
         slots_flat = r_slots.reshape(-1)
         mask_flat = jax.lax.stop_gradient(r_mask.reshape(-1))
@@ -322,7 +326,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
             return jax.lax.psum(mine, TABLE_AXIS)[0]
 
         if mode == "mvm_segment":
-            r_fields = a2a(fs_fields)
+            r_fields = wire_rows(a2a(fs_fields))
             seg = grow * nf + r_fields.reshape(-1)
             # mask rides as an extra channel: its segment-sum is the
             # per-(row, field) occurrence count => `present` (models/mvm.py)

@@ -119,8 +119,10 @@ def make_sorted_sharded_train_step(
         arrays are this data shard's full plan [Np_l]; labels/row_mask
         [B/D]. Storage may be packed (pack_table) — detected from the
         shard shape; slot indices stay logical."""
-        from xflow_tpu.ops.sorted_table import pack_of
+        from xflow_tpu.ops.sorted_table import pack_of, wire_mask, wire_rows
 
+        sorted_row = wire_rows(sorted_row)
+        sorted_mask = wire_mask(sorted_mask)
         K = 1 + cfg.model.v_dim
         t_idx = jax.lax.axis_index(TABLE_AXIS)
         # this shard's windows: global win_off sliced to [t*wpt, (t+1)*wpt]

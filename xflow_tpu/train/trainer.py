@@ -269,6 +269,8 @@ class Trainer:
             mvm = self.cfg.model.name == "mvm"
             want_fields = mvm and self._mvm_wants_fields(batch)
             try:
+                from xflow_tpu.ops.sorted_table import compact_plan_wire
+
                 out = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
                 out.update(
                     plan_fullshard_batch(
@@ -279,7 +281,13 @@ class Trainer:
                         fields=np.asarray(batch.fields) if want_fields else None,
                     )
                 )
-                return out
+                d_ax = self.mesh.shape["data"]
+                return compact_plan_wire(
+                    out,
+                    rows_bound=self.cfg.data.batch_size
+                    // (d_ax // jax.process_count()),
+                    fields_bound=self.cfg.model.num_fields if mvm else 0,
+                )
             except FullshardOverflowError:
                 if jax.process_count() > 1:
                     # a silent per-process fallback would desync the
@@ -321,6 +329,13 @@ class Trainer:
             )
             if want_fields:
                 arrays["sorted_fields"] = plan.sorted_fields
+            from xflow_tpu.ops.sorted_table import compact_plan_wire
+
+            arrays = compact_plan_wire(
+                arrays,
+                rows_bound=self.cfg.data.batch_size // max(self._sorted_sub, 1),
+                fields_bound=self.cfg.model.num_fields if want_fields else 0,
+            )
         return arrays
 
     # -------------------------------------------------------- multi-process IO
