@@ -104,6 +104,10 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true", help="tiny shapes for CI")
     ap.add_argument("--no-sorted", action="store_true",
                     help="disable the sorted-window layout (FM and MVM; ops/sorted_table.py)")
+    ap.add_argument("--dedup", action="store_true",
+                    help="host-dedup the row-major batches (unique_slots + "
+                         "inverse; measures docs/PERF.md lever 4 on the "
+                         "GSPMD-path step)")
     ap.add_argument("--sub-batches", type=int, default=0,
                     help="sorted-layout sub-batches per step (0 = auto)")
     ap.add_argument("--no-zipf", action="store_true",
@@ -209,6 +213,25 @@ def main() -> int:
                 else draw_slots(cfg.num_slots, "uniform")
             )
             batches = {**common, "slots": jnp.asarray(slots_np)}
+            # only the row-major step consumes dedup arrays; attaching them
+            # to a sorted-path run would measure dead transfers
+            if args.dedup and (args.no_sorted or name == "lr"):
+                # host dedup for the row-major step (data.dedup analog;
+                # the skewed-data / cross-chip-volume lever): ships
+                # (unique_slots, inverse) per scan step
+                from xflow_tpu.ops.sorted_table import dedup_slots
+
+                cap = int(B * F * 0.5)
+                pairs = [dedup_slots(slots_np[i], cap) for i in range(K)]
+                if all(p is not None for p in pairs):
+                    batches["unique_slots"] = jnp.asarray(
+                        np.stack([p[0] for p in pairs])
+                    )
+                    batches["inverse"] = jnp.asarray(np.stack([p[1] for p in pairs]))
+                    print(f"# {name}: dedup on, cap={cap}", file=sys.stderr)
+                else:
+                    print(f"# {name}: dedup overflow (uniques > {cap}); direct",
+                          file=sys.stderr)
             if name in ("fm", "mvm") and not args.no_sorted:
                 # sorted-window layout (ops/sorted_table.py): host-side
                 # plan, sub-batched like the trainer (cache-resident rows)

@@ -163,6 +163,24 @@ class DataConfig:
     #   across 'data' (D× table memory; parallel/sorted_sharded.py) —
     #   fewer collectives, viable when the table fits per-device HBM.
     sorted_mesh: str = "fullshard"
+    # host-side batch dedup for the ROW-MAJOR paths (reference analog:
+    # per-minibatch unique-key Pull, lr_worker.cc:150-165): ship
+    # (unique_slots, inverse) so the table gather moves U rows instead
+    # of B*F (ops/sorted_table.dedup_slots). DEFAULT OFF, from
+    # measurement: with packed tables the single-chip two-level gather
+    # LOSES at every tested skew (hot-head U=168k: 303k vs 503k ex/s
+    # direct — the [B, F] re-index gather costs as much as the direct
+    # gather it replaces; docs/PERF.md lever 4). Turn "auto" on for
+    # multi-chip GSPMD eval/fallback paths, where the win is CROSS-CHIP
+    # gather volume over ICI (U rows instead of B*F through the
+    # collectives), not local HBM traffic. "auto" applies to
+    # single-process row-major batches only (multi-process cannot dedup
+    # per batch: the unique count is data-dependent and the overflow
+    # fallback would bake different collective programs on different
+    # ranks); capacity = dedup_cap_frac * batch_size * max_nnz, the
+    # first batch decides for the run.
+    dedup: str = "off"
+    dedup_cap_frac: float = 0.5
     # packed table storage (ops/sorted_table.py pack_table): vector
     # tables live as [S/8, 8K] instead of [S, K]. TPU HBM buffers are
     # (8, 128)-tiled, so a logical [S, 11] f32 table is STORED [S, 128]

@@ -131,20 +131,20 @@ def _forward_sorted(tables, batch, cfg):
 def forward(tables, batch, cfg):
     if "sorted_slots" in batch and "wv" in tables:
         return _forward_sorted(tables, batch, cfg)
-    from xflow_tpu.ops.sorted_table import table_rows
+    from xflow_tpu.ops.sorted_table import batch_rows
 
     mask = batch["mask"]
     if "wv" in tables:
         # fused: ONE row gather for w and v (and one scatter in backward);
-        # table_rows is layout-blind (logical or packed storage)
-        wvg = table_rows(tables["wv"], batch["slots"], 1 + cfg.model.v_dim)
+        # batch_rows is layout-blind and honors host dedup (data.dedup)
+        wvg = batch_rows(tables["wv"], batch, 1 + cfg.model.v_dim)
         wx = (wvg[..., 0] * mask).sum(axis=-1)
         vg = wvg[..., 1:] * mask[..., None]
     else:
         w, v = tables["w"], tables["v"]
-        wg = w[batch["slots"]]  # [B, F]
+        wg = batch_rows(w, batch, 1)  # [B, F]
         wx = (wg * mask).sum(axis=-1)
-        vg = table_rows(v, batch["slots"], cfg.model.v_dim) * mask[..., None]
+        vg = batch_rows(v, batch, cfg.model.v_dim) * mask[..., None]
     return wx + _second_order(vg, cfg)
 
 

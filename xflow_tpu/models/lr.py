@@ -20,9 +20,13 @@ def _table_specs(cfg):
 
 
 def forward(tables, batch, cfg):
+    from xflow_tpu.ops.sorted_table import batch_rows
+
     w = tables["w"]
-    # Pull ≡ gather. [B, F] weights for every feature occurrence.
-    wg = w[batch["slots"]]
+    # Pull ≡ gather. [B, F] weights for every feature occurrence —
+    # through the host-deduped two-level gather when attached
+    # (data.dedup; the reference's unique-key Pull, lr_worker.cc:150-165)
+    wg = batch_rows(w, batch, 1)
     return (wg * batch["mask"]).sum(axis=-1)
 
 

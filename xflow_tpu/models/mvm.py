@@ -305,12 +305,12 @@ def _forward_sorted(tables, batch, cfg):
 def forward(tables, batch, cfg):
     if "sorted_slots" in batch:
         return _forward_sorted(tables, batch, cfg)
-    from xflow_tpu.ops.sorted_table import table_rows
+    from xflow_tpu.ops.sorted_table import batch_rows
 
     v = tables["v"]
     nf = cfg.model.num_fields
     mask = batch["mask"]
-    vg = table_rows(v, batch["slots"], cfg.model.v_dim) * mask[..., None]
+    vg = batch_rows(v, batch, cfg.model.v_dim) * mask[..., None]
     onehot = (batch["fields"][..., None] == jnp.arange(nf)) * mask[..., None]  # [B, F, nf]
     # full-precision einsum: the contraction is tiny (F × nf × k) and the
     # downstream product-of-fields amplifies any bf16 rounding

@@ -136,6 +136,38 @@ def wire_mask(sorted_mask):
     return sorted_mask.astype(jnp.float32)
 
 
+def dedup_slots(slots: np.ndarray, cap: int):
+    """Host-side batch dedup for the ROW-MAJOR paths (reference analog:
+    the per-minibatch unique-key Pull, `lr_worker.cc:150-165`):
+    returns (unique_slots [cap] padded with the last unique, inverse
+    [B, F] int32) or None when the batch has more than `cap` uniques
+    (the caller ships row-major and the step's direct-gather variant
+    runs — jit shapes must be static, so capacity is fixed).
+
+    The win is on SKEWED data and on a sharded mesh: the table gather
+    moves `cap` rows instead of B·F (cross-chip gather/scatter volume
+    shrinks by U/(B·F)); uniform batches at bench shapes have U ≈ 0.76
+    B·F and are not worth the host sort (docs/PERF.md lever 4)."""
+    flat = np.asarray(slots, np.int32).ravel()
+    u, inv = np.unique(flat, return_inverse=True)
+    if u.size > cap or u.size == 0:
+        return None
+    pad = np.full(cap - u.size, u[-1], np.int32)
+    return (
+        np.concatenate([u.astype(np.int32), pad]),
+        inv.astype(np.int32).reshape(np.asarray(slots).shape),
+    )
+
+
+def batch_rows(table, batch: dict, K: int):
+    """Per-occurrence LOGICAL table rows for a row-major batch: the
+    deduped two-level gather when the host attached (unique_slots,
+    inverse), else the direct gather. Layout-blind (`table_rows`)."""
+    if "unique_slots" in batch:
+        return table_rows(table, batch["unique_slots"], K)[batch["inverse"]]
+    return table_rows(table, batch["slots"], K)
+
+
 def table_rows(table, slots, K: int):
     """Logical rows ``table[slots]`` from EITHER storage layout — the
     row-major paths' (GSPMD step, mesh eval, non-sorted forwards)

@@ -80,6 +80,10 @@ def batch_sharding(mesh: Mesh) -> dict:
         "fs_mask": fs4,
         "fs_off": fs4,
         "fs_fields": fs4,
+        # host-dedup arrays (data.dedup): the unique set is global to the
+        # batch (replicated); the inverse indexes per row
+        "unique_slots": NamedSharding(mesh, P()),
+        "inverse": row2d,
     }
 
 

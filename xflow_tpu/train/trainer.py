@@ -221,6 +221,17 @@ class Trainer:
             # is a synchronous round trip each, which dominates on
             # high-latency links (tunneled devices: ~9 arrays × RTT/step)
             self._shard_batch = jax.device_put
+        # host dedup for row-major batches (ops/sorted_table.dedup_slots):
+        # single-process only — the unique count is data-dependent and a
+        # per-rank overflow fallback would desync collective programs
+        if cfg.data.dedup not in ("auto", "off"):
+            raise ValueError(f"data.dedup={cfg.data.dedup!r}: expected auto|off")
+        self._dedup_cap = (
+            int(cfg.data.batch_size * cfg.data.max_nnz * cfg.data.dedup_cap_frac)
+            if cfg.data.dedup == "auto" and jax.process_count() == 1
+            else 0
+        )
+        self._dedup_on = None  # undecided until the first row-major batch
         self.metrics = MetricsLogger(cfg.train.metrics_path)
         self._fullshard_overflow_warned = False
         # MVM keys its views on the field id: a field >= num_fields would be
@@ -304,7 +315,10 @@ class Trainer:
                         file=sys.stderr,
                     )
                     self.metrics.log({"fullshard_overflow_fallback": True})
-                return arrays  # row-major: the GSPMD step handles it
+                # row-major: the GSPMD step handles it — THROUGH dedup if
+                # enabled (overflow batches are the most skewed = exactly
+                # where the cross-chip dedup win lives)
+                return self._maybe_dedup(arrays, batch)
         if self._sorted and with_plan:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
@@ -336,6 +350,30 @@ class Trainer:
                 rows_bound=self.cfg.data.batch_size // max(self._sorted_sub, 1),
                 fields_bound=self.cfg.model.num_fields if want_fields else 0,
             )
+        else:
+            arrays = self._maybe_dedup(arrays, batch)
+        return arrays
+
+    def _maybe_dedup(self, arrays: dict, batch) -> dict:
+        """Attach the deduped gather arrays to a row-major batch when the
+        batch fits the capacity (data.dedup). The first batch DECIDES
+        for the run: if its unique count overflows (near-uniform data —
+        dedup unprofitable there anyway), stop paying the host np.unique
+        sort on every subsequent batch. On success the dead [B, F] slots
+        array is dropped from the transfer (batch_rows reads only
+        unique_slots/inverse)."""
+        if not self._dedup_cap or self._dedup_on is False:
+            return arrays
+        from xflow_tpu.ops.sorted_table import dedup_slots
+
+        got = dedup_slots(np.asarray(batch.slots), self._dedup_cap)
+        if got is not None:
+            arrays = dict(arrays)
+            arrays["unique_slots"], arrays["inverse"] = got
+            arrays.pop("slots", None)
+            self._dedup_on = True
+        elif self._dedup_on is None:
+            self._dedup_on = False
         return arrays
 
     # -------------------------------------------------------- multi-process IO
