@@ -203,3 +203,45 @@ def test_launch_local_two_process_sorted_engine(tmp_path, engine):
         err_msg="2-process sorted-sharded tables != single-process sorted tables",
     )
     np.testing.assert_allclose(d2["opt/wv/n"], d1["opt/wv/n"], rtol=1e-5, atol=1e-6)
+
+
+def test_launch_local_two_process_fullshard_mvm_product(tmp_path):
+    """Multi-process MVM on the fullshard engine's exclusive-fields
+    PRODUCT path (no fs_fields; synth data is one-feature-per-field, so
+    multi-process auto routing takes the product mode on every rank):
+    final tables match a single-process run on the batch-composed data."""
+    B, rows = 32, 96
+    mvm_args = [
+        "--model", "mvm", "--epochs", "2", "--log2-slots", "13",
+        "--set", "model.num_fields=4", "--set", "data.max_nnz=8",
+        "--set", "train.pred_dump=false", "--set", "data.sorted_layout=on",
+        "--set", "data.sorted_mesh=fullshard",
+    ]
+    generate_shards(str(tmp_path / "train"), 2, rows, num_fields=4, ids_per_field=50)
+    r2 = run_cli(
+        ["launch-local", "--num-processes", "2", "--",
+         "--train", str(tmp_path / "train"), "--batch-size", str(B),
+         "--checkpoint-dir", str(tmp_path / "ckpt2p"), *mvm_args],
+        tmp_path,
+    )
+    assert r2.returncode == 0, r2.stderr
+    s2 = json.loads(r2.stdout.strip().splitlines()[-1])
+
+    _interleave_shards(
+        [tmp_path / "train-00000", tmp_path / "train-00001"], B, tmp_path / "comb-00000"
+    )
+    r1 = run_cli(
+        ["train", "--train", str(tmp_path / "comb"), "--batch-size", str(2 * B),
+         "--checkpoint-dir", str(tmp_path / "ckpt1p"), "--no-mesh", *mvm_args],
+        tmp_path,
+    )
+    assert r1.returncode == 0, r1.stderr
+    s1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    assert s1["steps"] == s2["steps"]
+    d2 = np.load(tmp_path / "ckpt2p" / f"step_{s2['steps']}" / "state.npz")
+    d1 = np.load(tmp_path / "ckpt1p" / f"step_{s1['steps']}" / "state.npz")
+    np.testing.assert_allclose(
+        d2["tables/v"], d1["tables/v"], rtol=1e-4, atol=1e-6,
+        err_msg="2-process fullshard mvm-product != single-process",
+    )
+    np.testing.assert_allclose(d2["opt/v/n"], d1["opt/v/n"], rtol=1e-4, atol=1e-6)
