@@ -42,6 +42,50 @@ def test_orbax_roundtrip_sharded(tmp_path):
     assert len(restored.tables["wv"].addressable_shards) == 8
 
 
+def test_orbax_packed_layout_migration(tmp_path):
+    """Orbax stores the NATIVE (packed) layout; restoring into a
+    packed_tables=off run — or restoring a pre-packed (logical) ckpt into
+    a packed run — must migrate by reshape, like the npz path does."""
+    import jax.numpy as jnp
+
+    from xflow_tpu.ops.sorted_table import pack_of
+
+    base = {"data.log2_slots": 12}
+    cfg_packed = override(Config(), **base)  # auto => packed
+    cfg_logical = override(Config(), **{**base, "data.packed_tables": "off"})
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+    K = 1 + cfg_packed.model.v_dim
+
+    state = init_state(model, opt, cfg_packed)
+    assert pack_of(state.tables["wv"], K) > 1
+    state = state._replace(
+        tables={**state.tables, "wv": state.tables["wv"] + 0.25},
+        step=jnp.asarray(3, jnp.int32),
+    )
+    save_orbax(str(tmp_path), state)
+
+    # packed -> logical
+    like = init_state(model, opt, cfg_logical)
+    assert pack_of(like.tables["wv"], K) == 1
+    restored = restore_orbax(str(tmp_path), like)
+    assert restored.tables["wv"].shape == like.tables["wv"].shape
+    np.testing.assert_allclose(
+        np.asarray(restored.tables["wv"]),
+        np.asarray(state.tables["wv"]).reshape(like.tables["wv"].shape),
+    )
+    assert int(restored.step) == 3
+
+    # logical -> packed (round-trips back to the original packed values)
+    save_orbax(str(tmp_path / "logical"), restored)
+    back = restore_orbax(str(tmp_path / "logical"), init_state(model, opt, cfg_packed))
+    np.testing.assert_allclose(
+        np.asarray(back.tables["wv"]), np.asarray(state.tables["wv"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(back.opt_state["wv"]["n"]), np.asarray(state.opt_state["wv"]["n"])
+    )
+
+
 def test_trainer_orbax_resume(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     generate_shards(str(tmp_path / "train"), 1, 600, num_fields=5, ids_per_field=30, seed=0)

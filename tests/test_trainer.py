@@ -108,9 +108,45 @@ def test_export_sparse(dataset, tmp_path, monkeypatch):
     cfg = make_cfg(dataset, **{"train.epochs": 3})
     t = Trainer(cfg)
     t.fit()
-    n = export_sparse(t.state, str(tmp_path / "w.tsv"))
+    n = t.export_sparse(str(tmp_path / "w.tsv"))
     assert n > 0
     lines = open(tmp_path / "w.tsv").read().strip().split("\n")
     assert len(lines) == n
     slot, wval = lines[0].split("\t")
     assert float(wval) != 0.0
+
+
+def test_export_sparse_packed_fm(dataset, tmp_path, monkeypatch):
+    """export on the LIVE packed state must emit logical slot ids and pure
+    w / pure v columns (the packed [S/8, 8K] layout mixes them in storage).
+    Oracle: the npz checkpoint, which always stores the logical layout."""
+    monkeypatch.chdir(tmp_path)
+    ck = str(tmp_path / "ckpt")
+    cfg = make_cfg(dataset, **{"train.epochs": 2, "model.name": "fm",
+                               "train.checkpoint_dir": ck})
+    t = Trainer(cfg)
+    t.fit()
+    from xflow_tpu.ops.sorted_table import pack_of
+    K = 1 + cfg.model.v_dim
+    assert pack_of(t.state.tables["wv"], K) > 1, "state should be packed by default"
+
+    n_w = t.export_sparse(str(tmp_path / "w.tsv"), table="w")
+    n_v = t.export_sparse(str(tmp_path / "v.tsv"), table="v")
+    step = latest_step(ck)
+    wv_logical = np.load(os.path.join(ck, f"step_{step}", "state.npz"))["tables/wv"]
+    assert wv_logical.shape[1] == K  # npz stores logical layout
+
+    got_w = {int(l.split("\t")[0]): float(l.split("\t")[1])
+             for l in open(tmp_path / "w.tsv").read().strip().split("\n")}
+    want_w = {int(i): float(wv_logical[i, 0])
+              for i in np.nonzero(wv_logical[:, 0])[0]}
+    assert got_w == pytest.approx(want_w)
+    assert n_w == len(want_w) and n_v > 0
+
+    # v rows have v_dim columns, keyed by logical slot
+    first_v = open(tmp_path / "v.tsv").readline().rstrip("\n").split("\t")
+    assert len(first_v) == 1 + cfg.model.v_dim
+
+    # calling without widths on a packed 2-D table refuses loudly
+    with pytest.raises(ValueError, match="logical width"):
+        export_sparse(t.state, str(tmp_path / "bad.tsv"), table="v")

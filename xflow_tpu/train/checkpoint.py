@@ -103,6 +103,41 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def _put_migrated(label: str, arr, template, stored_tables, source: str):
+    """Place one stored array into a template leaf, migrating layout.
+
+    The single migration rule shared by the npz and orbax restore paths:
+    a size-equal shape difference is a packed [S/p, p*K] <-> logical
+    [S, K] layout change (a pure reshape); anything else is a real
+    structure mismatch. `arr is None` means the checkpoint lacks the
+    array entirely — most often a pre-fused FM checkpoint (two-table
+    layout) read by a fused-default run, so the error says how to bridge.
+    """
+    if arr is None:
+        raise RuntimeError(
+            f"checkpoint {source!r} has no array {label!r} (stored tables: "
+            f"{list(stored_tables)}). If this is an FM checkpoint written "
+            "with the two-table layout, set model.fm_fused=false to restore "
+            "it (or re-train; the fused [S,1+k] layout is the current "
+            "default)."
+        )
+    arr = np.asarray(arr)
+    if arr.shape != template.shape:
+        if arr.size != template.size:
+            raise RuntimeError(
+                f"checkpoint {source!r}: {label} stored shape {arr.shape} is "
+                f"incompatible with expected {template.shape} (sizes differ — "
+                "not a packed<->logical layout change)."
+            )
+        arr = arr.reshape(template.shape)
+    sharding = getattr(template, "sharding", None)
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    import jax.numpy as jnp
+
+    return jnp.asarray(arr)
+
+
 def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> TrainState:
     """Restore into the sharding/structure of `like` (device_put per leaf)."""
     step = latest_step(ckpt_dir) if step is None else step
@@ -110,23 +145,11 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
         raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir}")
     path = os.path.join(ckpt_dir, f"step_{step}")
     data = np.load(os.path.join(path, "state.npz"))
+    stored_tables = sorted(k.split("/", 1)[1] for k in data.files if k.startswith("tables/"))
 
     def put(name: str, template):
-        if name not in data:
-            raise KeyError(
-                f"checkpoint {path!r} has no array {name!r} (has "
-                f"{sorted(data.files)}). If this is an FM checkpoint written "
-                "with the two-table layout, set model.fm_fused=false to "
-                "restore it (or re-train; the fused [S,1+k] layout is the "
-                "current default)."
-            )
-        arr = data[name]
-        if arr.shape != template.shape and arr.size == template.size:
-            # layout migration: logical [S, K] stored <-> packed
-            # [S/p, p*K] expected (or the reverse) is a pure reshape
-            arr = arr.reshape(template.shape)
-        sharding = getattr(template, "sharding", None)
-        return jax.device_put(arr, sharding) if sharding is not None else arr
+        arr = data[name] if name in data else None
+        return _put_migrated(name, arr, template, stored_tables, path)
 
     tables = {n: put(f"tables/{n}", t) for n, t in like.tables.items()}
     opt_state = {
@@ -167,8 +190,47 @@ def latest_orbax_step(ckpt_dir: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def _orbax_stored_shapes(path: str) -> Optional[dict]:
+    """Stored array shapes from checkpoint metadata as {'a/b': shape},
+    without reading any array data. None when metadata is unavailable
+    (older orbax layouts) — callers then skip migration detection."""
+    import orbax.checkpoint as ocp
+
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = tuple(node.shape)
+
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            tree = ckptr.metadata(path).item_metadata.tree
+        if tree is None:
+            return None
+        walk("", tree)
+    except Exception:
+        return None
+    return flat
+
+
 def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> TrainState:
-    """Restore with `like`'s shardings (shards load directly per process)."""
+    """Restore with `like`'s shardings (shards load directly per process).
+
+    Layout migration: orbax stores the NATIVE (possibly packed [S/p, p*K])
+    device layout. Stored shapes are compared against `like`'s via the
+    checkpoint *metadata* (no array reads); only when they genuinely
+    differ — a `data.packed_tables` toggle, or a pre-packed checkpoint —
+    does restore take the migration path: a host-side restore +
+    size-equal reshape (the packed<->logical move is a pure reshape,
+    same rule as the npz path, `_put_migrated`). The migration path
+    materializes full arrays on each host — fine for a one-time
+    migration; re-save after restoring to get back on the shard-parallel
+    path. Matching shapes take the fast shard-parallel restore, and any
+    error there (corrupt shard, I/O) propagates as-is.
+    """
     import orbax.checkpoint as ocp
 
     step = latest_orbax_step(ckpt_dir) if step is None else step
@@ -176,25 +238,64 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
         raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir}")
     path = os.path.abspath(os.path.join(ckpt_dir, f"orbax_step_{step}"))
 
-    def as_abstract(x):
-        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+    like_tree = like._asdict()
+    expected = {}
+    for n, t in like.tables.items():
+        expected[f"tables/{n}"] = tuple(t.shape)
+    for n, st in like.opt_state.items():
+        for k, v in st.items():
+            expected[f"opt_state/{n}/{k}"] = tuple(v.shape)
+    stored_shapes = _orbax_stored_shapes(path)
+    migrate = stored_shapes is not None and any(
+        stored_shapes.get(k) != shp for k, shp in expected.items()
+    )
 
-    abstract = jax.tree.map(as_abstract, like._asdict())
-    try:
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(path, abstract)
-    except Exception as e:
-        if "wv" in like.tables:
-            # likely a pre-fused FM checkpoint (two-table layout): surface a
-            # migration hint instead of orbax's raw tree-mismatch error
-            raise RuntimeError(
-                f"orbax restore of {path!r} failed ({e}). If this is an FM "
-                "checkpoint written with the two-table layout, set "
-                "model.fm_fused=false to restore it — the fused [S,1+k] "
-                "layout is the current default."
-            ) from e
-        raise
-    return TrainState(**restored)
+    if not migrate:
+        def as_abstract(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+
+        abstract = jax.tree.map(as_abstract, like_tree)
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                restored = ckptr.restore(path, abstract)
+        except Exception as e:
+            if stored_shapes is None and "wv" in like.tables:
+                # metadata was unreadable, so migration detection could not
+                # run: if this is a pre-fused (two-table) FM checkpoint,
+                # say how to bridge instead of orbax's raw tree-mismatch
+                raise RuntimeError(
+                    f"orbax restore of {path!r} failed ({e}). If this is an "
+                    "FM checkpoint written with the two-table layout, set "
+                    "model.fm_fused=false to restore it — the fused [S,1+k] "
+                    "layout is the current default."
+                ) from e
+            raise
+        return TrainState(**restored)
+
+    # stored layout differs: host-side migration restore
+    import jax.numpy as jnp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        stored = ckptr.restore(path)  # host numpy, stored shapes
+    stored_tables = sorted(stored.get("tables", {}))
+
+    def put(label: str, arr, template):
+        return _put_migrated(label, arr, template, stored_tables, path)
+
+    tables = {
+        n: put(f"tables/{n}", stored.get("tables", {}).get(n), t)
+        for n, t in like.tables.items()
+    }
+    opt_state = {
+        n: {
+            k: put(f"opt_state/{n}/{k}", stored.get("opt_state", {}).get(n, {}).get(k), v)
+            for k, v in st.items()
+        }
+        for n, st in like.opt_state.items()
+    }
+    return TrainState(
+        tables=tables, opt_state=opt_state, step=jnp.asarray(stored["step"])
+    )
 
 
 def export_sparse_array(w: np.ndarray, out_path: str) -> int:
@@ -215,13 +316,43 @@ def export_sparse_array(w: np.ndarray, out_path: str) -> int:
     return int(nz.size)
 
 
-def export_sparse(state: TrainState, out_path: str, table: str = "w") -> int:
+def export_sparse(
+    state: TrainState,
+    out_path: str,
+    table: str = "w",
+    logical_widths: Optional[dict] = None,
+) -> int:
     """Dump nonzero weights of a table as `slot\\tweight` text; returns count.
 
     Understands the fused FM layout (models/fm.py): requesting "w" or "v"
-    from a state holding only "wv" slices the corresponding columns."""
+    from a state holding only "wv" slices the corresponding columns.
+
+    `logical_widths` ({table: K}, from `model.table_specs`) unpacks the
+    live packed [S/p, p*K] storage to logical [S, K] first, so slot ids
+    and column slices are correct. It is REQUIRED when the state holds
+    packed tables (the default since data.packed_tables landed) — without
+    it a packed 2-D table cannot be told apart from a genuinely wide
+    logical one, so we refuse rather than silently emit packed-row ids.
+    Prefer Trainer.export_sparse, which passes the widths for you.
+    """
+    widths = logical_widths or {}
+
+    def host(name: str) -> np.ndarray:
+        arr = _to_host(state.tables[name])
+        K = widths.get(name)
+        if K:
+            return _unpack_host(arr, K)
+        if arr.ndim == 2:
+            raise ValueError(
+                f"export_sparse: no logical width for 2-D table {name!r} "
+                f"(got logical_widths={sorted(widths)}) — cannot tell packed "
+                "from logical storage. Pass the model's table_specs widths "
+                "(Trainer.export_sparse does this)."
+            )
+        return arr
+
     if table not in state.tables and table in ("w", "v") and "wv" in state.tables:
-        wv = _to_host(state.tables["wv"])
+        wv = host("wv")
         arr = wv[:, 0] if table == "w" else wv[:, 1:]
         return export_sparse_array(arr, out_path)
-    return export_sparse_array(_to_host(state.tables[table]), out_path)
+    return export_sparse_array(host(table), out_path)

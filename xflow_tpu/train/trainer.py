@@ -778,12 +778,24 @@ class Trainer:
             # read one format
             ckpt.save_orbax(self.cfg.train.checkpoint_dir, self.state)
         else:
-            widths = {
-                name: trailing[0]
-                for name, trailing in self.model.table_specs(self.cfg).items()
-                if trailing
-            }
-            ckpt.save(self.cfg.train.checkpoint_dir, self.state, widths)
+            ckpt.save(self.cfg.train.checkpoint_dir, self.state, self._logical_widths())
+
+    def _logical_widths(self) -> dict:
+        """{table: K} logical row widths, for unpacking packed storage."""
+        return {
+            name: trailing[0]
+            for name, trailing in self.model.table_specs(self.cfg).items()
+            if trailing
+        }
+
+    def export_sparse(self, out_path: str, table: str = "w") -> int:
+        """Serving export of a table's nonzero rows, unpacking the live
+        packed storage via the model's logical widths (checkpoint.export_sparse)."""
+        from xflow_tpu.train import checkpoint as ckpt
+
+        return ckpt.export_sparse(
+            self.state, out_path, table, logical_widths=self._logical_widths()
+        )
 
     def maybe_restore(self) -> bool:
         from xflow_tpu.train import checkpoint as ckpt
