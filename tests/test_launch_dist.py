@@ -137,6 +137,73 @@ def test_launch_dist_two_hosts_bitmatch(tmp_path):
     np.testing.assert_allclose(d2["opt/w/n"], d1["opt/w/n"], rtol=0, atol=1e-6)
 
 
+def _pids_with_env(key: bytes) -> list:
+    """All live pids whose environment contains `key` (via /proc)."""
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                if key in f.read():
+                    out.append(int(pid))
+        except OSError:
+            continue
+    return out
+
+
+def test_launch_dist_ranks_die_with_launcher(tmp_path):
+    """The die-with-connection wrapper (rank_command): SIGKILL the
+    launcher itself — no graceful teardown runs — and the rank
+    processes must still exit, because the launcher's death closes the
+    held-open ssh stdin pipes and the remote watcher TERMs each rank.
+    Without the wrapper, ssh'd ranks blocked in collectives outlive the
+    launcher and hold the coordinator port (ADVICE r3)."""
+    generate_shards(str(tmp_path / "train"), 2, 4000, num_fields=4, ids_per_field=50)
+    hosts = tmp_path / "hosts"
+    hosts.write_text("127.0.0.1\n127.0.0.1\n")
+    marker = f"XFLOW_DIEWITH_{os.getpid()}"
+    p = subprocess.Popen(
+        [sys.executable, "-m", "xflow_tpu", "launch-dist",
+         "--hosts", str(hosts), "--port", str(_free_port()),
+         "--ssh-cmd", _fake_ssh(tmp_path),
+         "--workdir", str(tmp_path / "rank{rank}"),
+         "--python", sys.executable,
+         "--env", "JAX_PLATFORMS=cpu",
+         "--env", "PYTHONPATH=" + REPO_ROOT,
+         "--env", marker + "=1",
+         "--", "--train", str(tmp_path / "train"),
+         "--batch-size", "20", "--model", "lr", "--epochs", "100000",
+         "--log2-slots", "10", "--set", "model.num_fields=4",
+         "--set", "data.max_nnz=8", "--set", "train.pred_dump=false"],
+        env=_clean_env(), stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            ranks = _pids_with_env(marker.encode())
+            if len(ranks) >= 2:
+                break
+            assert p.poll() is None, "launcher died before ranks started"
+            time.sleep(0.3)
+        assert len(ranks) >= 2, f"ranks never started: {ranks}"
+        os.kill(p.pid, signal.SIGKILL)  # no teardown() runs
+        p.wait()
+        deadline = time.time() + 30  # watcher: TERM immediately, KILL +5s
+        while time.time() < deadline:
+            alive = [r for r in _pids_with_env(marker.encode()) if r != p.pid]
+            if not alive:
+                break
+            time.sleep(0.5)
+        assert not alive, f"rank pids outlived the launcher: {alive}"
+    finally:
+        for pid in _pids_with_env(marker.encode()):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
 def _children_by_rank(parent_pid: int) -> dict:
     """rank -> pid of `xflow train` children, via /proc (Linux)."""
     out = {}

@@ -205,6 +205,71 @@ def test_launch_local_two_process_sorted_engine(tmp_path, engine):
     np.testing.assert_allclose(d2["opt/wv/n"], d1["opt/wv/n"], rtol=1e-5, atol=1e-6)
 
 
+def test_launch_local_two_process_fullshard_hot_key_fallback(tmp_path):
+    """Round-3 weak #1 gate: a hot feature skewed beyond the fullshard
+    buffer capacity must NOT kill a multi-process run. Rank 0's shard
+    carries a 100%-frequency feature (6 of 8 occurrences per row — its
+    owner block gets ~75% of the shard's occurrences, far over slack
+    1.25); rank 1's shard is uniform, so ONLY rank 0 overflows — the
+    asymmetric case where rank 1 must drop its own (successful) plan via
+    the per-batch flag allgather and join rank 0 on the GSPMD row-major
+    step. Gate: trains through, warns, and bit-matches the
+    single-process run on the batch-composed data. Reference behavior
+    matched: ps-lite serves hot keys slowly but never dies
+    (`/root/reference/src/optimizer/ftrl.h:54-79`)."""
+    B, rows = 1024, 2048
+    rng = np.random.default_rng(5)
+    hot = " ".join(["0:0:1.0"] * 6)
+    with open(tmp_path / "train-00000", "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{fg}:{rng.integers(0, 50)}:1.0" for fg in (1, 2)
+            )
+            f.write(f"{i % 2}\t{hot} {feats}\n")
+    with open(tmp_path / "train-00001", "w") as f:
+        for i in range(rows):
+            feats = " ".join(
+                f"{fg}:{rng.integers(0, 50)}:1.0" for fg in range(1, 4) for _ in range(2)
+            )
+            f.write(f"{(i + 1) % 2}\t{feats}\n")
+    fm_args = [
+        "--model", "fm", "--epochs", "1", "--log2-slots", "13",
+        "--set", "model.num_fields=4", "--set", "data.max_nnz=8",
+        "--set", "train.pred_dump=false", "--set", "data.sorted_layout=on",
+        "--set", "data.sorted_mesh=fullshard",
+        "--set", "data.fullshard_slack=1.25",
+    ]
+    r2 = run_cli(
+        ["launch-local", "--num-processes", "2", "--",
+         "--train", str(tmp_path / "train"), "--batch-size", str(B),
+         "--checkpoint-dir", str(tmp_path / "ckpt2p"), *fm_args],
+        tmp_path,
+    )
+    assert r2.returncode == 0, r2.stderr
+    assert "falling back to the GSPMD row-major step" in r2.stderr
+    s2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert s2["steps"] == rows // B
+
+    _interleave_shards(
+        [tmp_path / "train-00000", tmp_path / "train-00001"], B, tmp_path / "comb-00000"
+    )
+    r1 = run_cli(
+        ["train", "--train", str(tmp_path / "comb"), "--batch-size", str(2 * B),
+         "--checkpoint-dir", str(tmp_path / "ckpt1p"), "--no-mesh", *fm_args],
+        tmp_path,
+    )
+    assert r1.returncode == 0, r1.stderr
+    s1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    assert s1["steps"] == s2["steps"]
+    d2 = np.load(tmp_path / "ckpt2p" / f"step_{s2['steps']}" / "state.npz")
+    d1 = np.load(tmp_path / "ckpt1p" / f"step_{s1['steps']}" / "state.npz")
+    np.testing.assert_allclose(
+        d2["tables/wv"], d1["tables/wv"], rtol=1e-4, atol=1e-6,
+        err_msg="2-process hot-key fallback != single-process on composed data",
+    )
+    np.testing.assert_allclose(d2["opt/wv/n"], d1["opt/wv/n"], rtol=1e-4, atol=1e-6)
+
+
 def test_launch_local_two_process_fullshard_mvm_product(tmp_path):
     """Multi-process MVM on the fullshard engine's exclusive-fields
     PRODUCT path (no fs_fields; synth data is one-feature-per-field, so

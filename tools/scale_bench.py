@@ -145,7 +145,11 @@ def main() -> int:
     ap.add_argument("--rows", type=int, default=10_000_000)
     ap.add_argument("--test-rows", type=int, default=1_000_000)
     ap.add_argument("--fields", type=int, default=18)
-    ap.add_argument("--ids-per-field", type=int, default=600_000)
+    # default matches the committed BENCH_SCALE.json meta (ids_per_field
+    # 1M -> 10.57M distinct features into 2^24 slots): a bare
+    # `python tools/scale_bench.py` reuses/regenerates the SAME dataset
+    # and regresses against the recorded numbers
+    ap.add_argument("--ids-per-field", type=int, default=1_000_000)
     ap.add_argument("--zipf-alpha", type=float, default=1.1)
     ap.add_argument("--log2-slots", type=int, default=24)
     ap.add_argument("--batch", type=int, default=65536)

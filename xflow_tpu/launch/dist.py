@@ -90,7 +90,29 @@ def rank_command(
              *(shlex.quote(a) for a in forward_args)]
         )
     )
-    return " && ".join(parts)
+    inner = " && ".join(parts)
+    # die-with-connection wrapper: ssh without a TTY does NOT signal the
+    # remote command when the client dies, so a rank blocked in a
+    # collective would outlive a fail-fast teardown and hold the
+    # coordinator port. The launcher holds the ssh client's stdin open
+    # (stdin=PIPE, never written); the watcher `read` below unblocks only
+    # when that pipe closes — client exit, kill, or network drop — and
+    # then TERMs (5 s later KILLs) the rank. Normal completion reaps the
+    # watcher and preserves the rank's exit status.
+    # `exec 3<&0` + `<&3`: background jobs get /dev/null stdin (POSIX),
+    # so the watcher must be fed the session's real stdin explicitly.
+    # `set -m` (where supported) makes the subshell a process-group
+    # leader so `kill -- -$xfp` reaps the whole tree; the plain-pid kill
+    # covers shells without job control, where it still reaches the rank
+    # because bash/dash tail-exec the last command of the subshell
+    # (verified on both) — python IS $xfp there.
+    return (
+        f"exec 3<&0; set -m 2>/dev/null; ( {inner} ) & xfp=$!; set +m 2>/dev/null; "
+        "{ while read -r xfl; do :; done; "
+        "kill -TERM -- -$xfp 2>/dev/null; kill -TERM $xfp 2>/dev/null; sleep 5; "
+        "kill -KILL -- -$xfp 2>/dev/null; kill -KILL $xfp 2>/dev/null; } <&3 & "
+        "xfw=$!; wait $xfp; xfs=$?; kill $xfw 2>/dev/null; exit $xfs"
+    )
 
 
 def launch_dist(
@@ -130,10 +152,37 @@ def launch_dist(
         return 0
     procs = []
     grace_s = 10.0
+
+    def teardown(procs):
+        """Close stdin pipes first (the remote die-with-connection
+        watcher fires on EOF — the graceful path even over dead ssh
+        clients), then TERM the local clients, then KILL stragglers:
+        ssh ignoring TERM must not leave the launcher hanging."""
+        for p in procs:
+            if p.stdin:
+                try:
+                    p.stdin.close()
+                except OSError:
+                    pass
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(p.poll() is None for p in procs):
+            time.sleep(0.2)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
     try:
         for i in reversed(range(len(hosts))):
+            # stdin=PIPE, held open and never written: its EOF is the
+            # remote watcher's death signal (rank_command wrapper)
             procs.append(
-                subprocess.Popen([*shlex.split(ssh_cmd), hosts[i], cmds[i]])
+                subprocess.Popen(
+                    [*shlex.split(ssh_cmd), hosts[i], cmds[i]],
+                    stdin=subprocess.PIPE,
+                )
             )
         first_bad = 0
         while True:
@@ -152,15 +201,12 @@ def launch_dist(
                     p.poll() is None for p in procs
                 ):
                     time.sleep(0.5)
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
+                teardown(procs)
             if all(c is not None for c in codes):
                 return first_bad or next((c for c in codes if c), 0)
             time.sleep(0.5)
     except KeyboardInterrupt:
-        for p in procs:
-            p.terminate()
+        teardown(procs)
         for p in procs:
             p.wait()
         raise

@@ -10,10 +10,10 @@ Reference: `/root/reference/src/base/base.h`.
   normalize by tp·fp). Two reference accidents fixed (SURVEY.md §7):
   logloss uses natural log, not `std::log2` (`base.h:97`), and the
   accumulator is not carried across calls (`base.h:113` never resets).
-- `BucketAUC` is a streaming, device-side alternative: histogram
-  positives/negatives by score bucket; counts are summable across
-  batches and hosts (psum/allreduce) so giant eval sets never need a
-  global sort.
+- `BucketAUC` is a streaming alternative: histogram positives/negatives
+  by score bucket on the host as scores come off the device; counts are
+  summable across batches and hosts (one allgather per eval pass) so
+  giant eval sets never need a global sort.
 """
 
 from __future__ import annotations
@@ -67,22 +67,31 @@ def auc_logloss(pctrs: np.ndarray, labels: np.ndarray, log2: bool = False) -> tu
 
 
 class BucketAUC(NamedTuple):
-    """Streaming AUC state: per-bucket positive/negative counts."""
+    """Streaming AUC state: per-bucket positive/negative counts.
 
-    pos: jnp.ndarray  # [num_buckets]
-    neg: jnp.ndarray  # [num_buckets]
+    HOST-side accumulation in float64 (np.bincount): eval scores come
+    off the device per batch anyway (for the pred dump and logloss), and
+    float64 counts stay exact past 2^24 rows where a float32 device
+    histogram would saturate. Counts are plain sums, so cross-batch and
+    cross-host merging is addition (trainer._evaluate_bucketed allgathers
+    and sums them once per eval pass)."""
+
+    pos: np.ndarray  # [num_buckets]
+    neg: np.ndarray  # [num_buckets]
 
     @staticmethod
     def init(num_buckets: int = 8192) -> "BucketAUC":
-        z = jnp.zeros((num_buckets,), dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+        z = np.zeros((num_buckets,), dtype=np.float64)
         return BucketAUC(pos=z, neg=z)
 
-    def update(self, pctrs: jnp.ndarray, labels: jnp.ndarray, weights=None) -> "BucketAUC":
+    def update(self, pctrs, labels, weights=None) -> "BucketAUC":
         nb = self.pos.shape[0]
-        idx = jnp.clip((pctrs * nb).astype(jnp.int32), 0, nb - 1)
-        w = jnp.ones_like(pctrs) if weights is None else weights
-        pos = self.pos.at[idx].add(labels * w)
-        neg = self.neg.at[idx].add((1.0 - labels) * w)
+        p = np.asarray(pctrs, np.float64)
+        y = np.asarray(labels, np.float64)
+        w = np.ones_like(p) if weights is None else np.asarray(weights, np.float64)
+        idx = np.clip((p * nb).astype(np.int64), 0, nb - 1)
+        pos = self.pos + np.bincount(idx, weights=y * w, minlength=nb)
+        neg = self.neg + np.bincount(idx, weights=(1.0 - y) * w, minlength=nb)
         return BucketAUC(pos=pos, neg=neg)
 
     def compute(self) -> float:

@@ -123,11 +123,28 @@ def _put_migrated(label: str, arr, template, stored_tables, source: str):
         )
     arr = np.asarray(arr)
     if arr.shape != template.shape:
-        if arr.size != template.size:
+        from xflow_tpu.ops.sorted_table import PACK
+
+        def pack_related(a, b):
+            # a = logical [S, K], b = packed [S/PACK, PACK*K]?
+            return (
+                len(a) == len(b) == 2
+                and a[0] == b[0] * PACK
+                and b[1] == a[1] * PACK
+            )
+
+        # only a pack toggle is a pure reshape; equal-size coincidences
+        # (e.g. v_dim 8 -> 4 with log2_slots + 1) would interleave
+        # unrelated rows and silently corrupt the restored state
+        if not (
+            pack_related(arr.shape, template.shape)
+            or pack_related(template.shape, arr.shape)
+        ):
             raise RuntimeError(
                 f"checkpoint {source!r}: {label} stored shape {arr.shape} is "
-                f"incompatible with expected {template.shape} (sizes differ — "
-                "not a packed<->logical layout change)."
+                f"incompatible with expected {template.shape} — not a packed "
+                f"[S/{PACK}, {PACK}*K] <-> logical [S, K] layout change "
+                "(did model dims or log2_slots change?)."
             )
         arr = arr.reshape(template.shape)
     sharding = getattr(template, "sharding", None)

@@ -300,11 +300,6 @@ class Trainer:
                     fields_bound=self.cfg.model.num_fields if mvm else 0,
                 )
             except FullshardOverflowError:
-                if jax.process_count() > 1:
-                    # a silent per-process fallback would desync the
-                    # collective programs across ranks and deadlock; the
-                    # planner's error carries the slack advice
-                    raise
                 if not self._fullshard_overflow_warned:
                     self._fullshard_overflow_warned = True
                     print(
@@ -317,8 +312,15 @@ class Trainer:
                     self.metrics.log({"fullshard_overflow_fallback": True})
                 # row-major: the GSPMD step handles it — THROUGH dedup if
                 # enabled (overflow batches are the most skewed = exactly
-                # where the cross-chip dedup win lives)
-                return self._maybe_dedup(arrays, batch)
+                # where the cross-chip dedup win lives). Multi-process: the
+                # marker makes _resolve_fullshard_overflow (fit loop, main
+                # thread) pull EVERY rank onto the row-major step for this
+                # batch — a per-rank fallback would desync the ranks'
+                # collective programs and deadlock.
+                arrays = self._maybe_dedup(arrays, batch)
+                if jax.process_count() > 1:
+                    arrays["_fs_overflow"] = True
+                return arrays
         if self._sorted and with_plan:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
@@ -352,6 +354,44 @@ class Trainer:
             )
         else:
             arrays = self._maybe_dedup(arrays, batch)
+        return arrays
+
+    def _resolve_fullshard_overflow(self, batch, arrays: dict) -> dict:
+        """Rank-symmetric per-batch engine agreement (round-3 weak #1).
+
+        Multi-process fullshard only: every rank contributes a 1-int
+        "my batch overflowed the occurrence buffers" flag to one host
+        allgather per batch, and if ANY rank overflowed, ALL ranks run
+        the GSPMD row-major step for this batch (the state sharding is
+        identical, so the two jitted programs interleave freely — the
+        same dispatch the single-process fallback uses). Ranks whose
+        plan succeeded rebuild the row-major arrays from the still-held
+        SparseBatch (a host reshape, no re-parse). The reference never
+        dies on a hot key — its PS just serves it slowly
+        (`/root/reference/src/optimizer/ftrl.h:54-79`); neither do we.
+
+        Cost: one [1]-int32 host allgather per train batch, ~100-200 µs
+        on CPU rendezvous — noise against the ≥40 ms device step at
+        bench shapes (docs/DISTRIBUTED.md "Hot keys"). Runs on the MAIN
+        thread (the prefetch thread builds plans; collectives from two
+        threads could interleave across ranks).
+        """
+        if self._mesh_engine != "fullshard" or jax.process_count() == 1:
+            return arrays
+        from jax.experimental import multihost_utils
+
+        mine = bool(arrays.pop("_fs_overflow", False))
+        any_over = int(
+            np.asarray(
+                multihost_utils.process_allgather(np.int32(mine))
+            ).max()
+        )
+        if any_over and not mine:
+            # a peer overflowed: drop my fullshard plan, rebuild row-major.
+            # No dedup here — multi-process forces _dedup_cap off
+            # (per-batch capacity routing would give ranks different
+            # jitted programs, the exact desync this method prevents)
+            arrays = batch_to_arrays(batch)
         return arrays
 
     def _maybe_dedup(self, arrays: dict, batch) -> dict:
@@ -423,9 +463,13 @@ class Trainer:
     def _coordinated_batches(self, path: str, with_plan: bool = True):
         """Yield exactly the globally-agreed number of (batch, arrays)
         pairs for `path`, padding with fully-masked empty batches once
-        local input is exhausted. Collective-free on the host side after
-        the one counting allgather (cached across epochs). `with_plan`
-        false skips sorted-plan building (mesh eval runs row-major)."""
+        local input is exhausted. One counting allgather per (path,
+        pass) — re-counted every pass so shards that appear, grow, or
+        shrink between epochs are picked up (`_global_batch_count`);
+        the batch stream itself adds no host collectives (the fullshard
+        overflow flag, when that engine is on, is the fit loop's, not
+        this iterator's). `with_plan` false skips sorted-plan building
+        (mesh eval runs row-major)."""
         prepare = lambda b: self._with_arrays(b, with_plan=with_plan)
         if jax.process_count() == 1:
             yield from prefetch(
@@ -546,6 +590,7 @@ class Trainer:
         try:
             for epoch in range(cfg.train.epochs):
                 for batch, arrays in self._coordinated_batches(path):
+                    arrays = self._resolve_fullshard_overflow(batch, arrays)
                     arrays = self._shard_batch(arrays)
                     self.state, m = self.train_step(self.state, arrays)
                     last_metrics = m
@@ -723,8 +768,7 @@ class Trainer:
         """
         from xflow_tpu.metrics import BucketAUC
 
-        pos = np.zeros(num_buckets, np.float64)
-        neg = np.zeros(num_buckets, np.float64)
+        st = BucketAUC.init(num_buckets)
         ll_sum, n_rows = 0.0, 0.0
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         for batch, arrays in self._coordinated_batches(
@@ -735,9 +779,7 @@ class Trainer:
             rm = np.asarray(batch.row_mask) > 0
             y = np.asarray(batch.labels)[rm]
             p = np.asarray(p, np.float64)[rm]
-            idx = np.clip((p * num_buckets).astype(np.int64), 0, num_buckets - 1)
-            pos += np.bincount(idx, weights=y, minlength=num_buckets)
-            neg += np.bincount(idx, weights=1.0 - y, minlength=num_buckets)
+            st = st.update(p, y)
             eps = 1e-15
             pc = np.clip(p, eps, 1.0 - eps)
             ll_sum += float((y * np.log(pc) + (1.0 - y) * np.log(1.0 - pc)).sum())
@@ -747,7 +789,7 @@ class Trainer:
                     fout.write(f"{pi:.6f}\t{int(1 - yi)}\t{int(yi)}\n")
         if fout:
             fout.close()
-        stats = np.concatenate([pos, neg, [ll_sum, n_rows]])
+        stats = np.concatenate([st.pos, st.neg, [ll_sum, n_rows]])
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
