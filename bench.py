@@ -28,27 +28,26 @@ import numpy as np
 PER_CHIP_TARGET = 50_000_000 / 64  # north-star pod target / chips
 
 
-def bench_e2e(args) -> int:
+def measure_e2e(args, model: str, rows: int) -> float:
     """End-to-end trainer throughput: libffm file on disk → C++ parser →
     (sorted plan in the prefetch thread) → jitted device step. This is
     the number a user actually gets from `xflow train`, as opposed to
     the pre-staged device-only headline — the gap between them is the
     host data plane (docs/PERF.md "Host data plane"). Epoch 1 warms the
-    compile caches; epoch 2 is timed."""
+    compile caches; epoch 2 is timed. Returns examples/sec."""
     import os
     import tempfile
     import time as _time
 
     from xflow_tpu.config import Config, override
-    from xflow_tpu.data.synth import generate_shards
+    from xflow_tpu.data.synth import generate_shards_bulk
     from xflow_tpu.train.trainer import Trainer
 
-    model = "fm" if args.model in ("all", "fm") else args.model
-    rows = args.e2e_rows if not args.smoke else 20_000
     with tempfile.TemporaryDirectory() as td:
         prefix = os.path.join(td, "train")
         t0 = _time.perf_counter()
-        generate_shards(prefix, 1, rows, num_fields=18, ids_per_field=200_000, seed=0)
+        generate_shards_bulk(prefix, 1, rows, num_fields=18,
+                             ids_per_field=200_000, seed=0)
         gen_s = _time.perf_counter() - t0
         cfg = override(
             Config(),
@@ -77,19 +76,27 @@ def bench_e2e(args) -> int:
         rate = res.examples / secs
         print(
             f"# e2e[{model}]: rows={rows} gen={gen_s:.1f}s warm={res_warm.seconds:.1f}s "
-            f"timed_epoch={secs:.2f}s steps={res.steps} sorted={trainer._sorted}",
+            f"timed_epoch={secs:.2f}s steps={res.steps} sorted={trainer._sorted} "
+            f"parser_threads=auto({os.cpu_count()} cores)",
             file=sys.stderr,
         )
-        print(
-            json.dumps(
-                {
-                    "metric": f"e2e_{model}_examples_per_sec",
-                    "value": round(rate, 1),
-                    "unit": "examples/sec",
-                    "vs_baseline": round(rate / PER_CHIP_TARGET, 3),
-                }
-            )
+        return rate
+
+
+def bench_e2e(args) -> int:
+    model = "fm" if args.model in ("all", "fm") else args.model
+    rows = args.e2e_rows if not args.smoke else 20_000
+    rate = measure_e2e(args, model, rows)
+    print(
+        json.dumps(
+            {
+                "metric": f"e2e_{model}_examples_per_sec",
+                "value": round(rate, 1),
+                "unit": "examples/sec",
+                "vs_baseline": round(rate / PER_CHIP_TARGET, 3),
+            }
         )
+    )
     return 0
 
 
@@ -100,7 +107,8 @@ def main() -> int:
     ap.add_argument("--log2-slots", type=int, default=22)
     ap.add_argument("--scan-steps", type=int, default=32, help="train steps per compiled program")
     ap.add_argument("--repeats", type=int, default=5)
-    ap.add_argument("--model", default="all", help="lr|fm|mvm|all (all = one JSON line, LR headline)")
+    ap.add_argument("--model", default="all",
+                    help="lr|fm|mvm|ffm|all (all = one JSON line, LR headline)")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes for CI")
     ap.add_argument("--no-sorted", action="store_true",
                     help="disable the sorted-window layout (FM and MVM; ops/sorted_table.py)")
@@ -142,16 +150,17 @@ def main() -> int:
     K, B, F = args.scan_steps, args.batch, args.nnz
     rng = np.random.default_rng(0)
 
-    def draw_slots(num_slots: int, dist: str) -> np.ndarray:
+    def draw_slots(num_slots: int, dist: str, shape=None) -> np.ndarray:
         """[K, B, F] slot ids. 'zipf' draws ranks from a bounded power law
         (alpha=1.05, Criteo-like head) and scrambles them with a
         multiplicative bijection mod 2^k so frequency skew survives but
         index locality (an artifact no hashed id stream has) does not."""
+        shape = shape or (K, B, F)
         if dist == "uniform":
-            return rng.integers(0, num_slots, (K, B, F)).astype(np.int32)
+            return rng.integers(0, num_slots, shape).astype(np.int32)
         pmf = 1.0 / np.arange(1, num_slots + 1, dtype=np.float64) ** 1.05
         cdf = np.cumsum(pmf / pmf.sum())
-        ranks = np.searchsorted(cdf, rng.random((K, B, F)))
+        ranks = np.searchsorted(cdf, rng.random(shape))
         return ((ranks * 2654435761) % num_slots).astype(np.int32)
 
     if args.e2e:
@@ -159,7 +168,8 @@ def main() -> int:
 
     zipf_slots_cache = {}
 
-    def bench_model(name: str, dists, dup_fields: bool = False) -> dict:
+    def bench_model(name: str, dists, dup_fields: bool = False,
+                    log2_slots: int = 0, batch: int = 0, nnz: int = 0) -> dict:
         """Compile the model's K-step program ONCE, then time each slot
         distribution on it (shapes identical → no recompile).
 
@@ -170,12 +180,22 @@ def main() -> int:
         `dup_fields=True` instead draws random fields over num_fields=18
         (every row has duplicate fields), exercising the general
         segment-sum path — recorded as the `mvm_dupfields_*` companion.
+
+        FFM benches at its practical shape — 18 one-feature-per-field
+        fields, k=4 per opposing field (a [S, 73] fused row), B capped
+        at 16k: its per-(row, field) segment state is nf× a row, so the
+        64k-row shape would be all sub-batch fragmentation.
+
+        `log2_slots`/`batch`/`nnz` override the CLI shape (0 = CLI) —
+        the 2^24 north-star companion runs use them.
         """
+        log2_slots = log2_slots or args.log2_slots
+        B_, F_ = batch or args.batch, nnz or args.nnz
         overrides = {
             "model.name": name,
-            "data.log2_slots": args.log2_slots,
-            "data.max_nnz": args.nnz,
-            "data.batch_size": args.batch,
+            "data.log2_slots": log2_slots,
+            "data.max_nnz": F_,
+            "data.batch_size": B_,
             "data.sorted_sub_batches": args.sub_batches,
             "data.sorted_bf16": args.sorted_bf16,
         }
@@ -183,34 +203,38 @@ def main() -> int:
             if dup_fields:
                 overrides["model.mvm_exclusive"] = "off"
             else:
-                overrides["model.num_fields"] = args.nnz
+                overrides["model.num_fields"] = F_
                 overrides["model.mvm_exclusive"] = "on"
+        if name == "ffm":
+            overrides["model.num_fields"] = F_
+            overrides["model.v_dim"] = 4
         cfg = override(Config(), **overrides)
         model, opt = get_model(name), get_optimizer("ftrl")
         step = make_train_step(model, opt, cfg, jit=False)
-        mask_np = (rng.random((K, B, F)) < 0.6).astype(np.float32)
-        if name == "mvm" and not dup_fields:
+        mask_np = (rng.random((K, B_, F_)) < 0.6).astype(np.float32)
+        if name in ("mvm", "ffm") and not dup_fields:
             fields_host = np.broadcast_to(
-                np.arange(F, dtype=np.int32), (K, B, F)
+                np.arange(F_, dtype=np.int32), (K, B_, F_)
             ).copy()
         else:
             fields_host = rng.integers(
-                0, cfg.model.num_fields, (K, B, F)
+                0, cfg.model.num_fields, (K, B_, F_)
             ).astype(np.int32)
         common = {
             "fields": jnp.asarray(fields_host),
             "mask": jnp.asarray(mask_np),
-            "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
-            "row_mask": jnp.ones((K, B), jnp.float32),
+            "labels": jnp.asarray((rng.random((K, B_)) < 0.4).astype(np.float32)),
+            "row_mask": jnp.ones((K, B_), jnp.float32),
         }
 
         def make_batches(dist: str) -> dict:
-            if dist == "zipf" and cfg.num_slots not in zipf_slots_cache:
-                zipf_slots_cache[cfg.num_slots] = draw_slots(cfg.num_slots, "zipf")
+            ck = (cfg.num_slots, B_, F_)
+            if dist == "zipf" and ck not in zipf_slots_cache:
+                zipf_slots_cache[ck] = draw_slots(cfg.num_slots, "zipf", (K, B_, F_))
             slots_np = (
-                zipf_slots_cache[cfg.num_slots]
+                zipf_slots_cache[ck]
                 if dist == "zipf"
-                else draw_slots(cfg.num_slots, "uniform")
+                else draw_slots(cfg.num_slots, "uniform", (K, B_, F_))
             )
             batches = {**common, "slots": jnp.asarray(slots_np)}
             # only the row-major step consumes dedup arrays; attaching them
@@ -221,7 +245,7 @@ def main() -> int:
                 # (unique_slots, inverse) per scan step
                 from xflow_tpu.ops.sorted_table import dedup_slots
 
-                cap = int(B * F * 0.5)
+                cap = int(B_ * F_ * 0.5)
                 pairs = [dedup_slots(slots_np[i], cap) for i in range(K)]
                 if all(p is not None for p in pairs):
                     batches["unique_slots"] = jnp.asarray(
@@ -233,6 +257,10 @@ def main() -> int:
                     print(f"# {name}: dedup overflow (uniques > {cap}); direct",
                           file=sys.stderr)
             if name in ("fm", "mvm") and not args.no_sorted:
+                # (FFM deliberately absent: its single-device default IS
+                # the row-major einsum path — the sorted segment engine
+                # measured slower there, docs/PERF.md round-4 #5 — so
+                # this benches what `xflow train --model ffm` runs)
                 # sorted-window layout (ops/sorted_table.py): host-side
                 # plan, sub-batched like the trainer (cache-resident rows)
                 from xflow_tpu.ops.sorted_table import (
@@ -299,12 +327,12 @@ def main() -> int:
                 times.append(time.perf_counter() - t0)
             best = min(times)
             print(
-                f"# {name}[{dist}]: device={jax.devices()[0]} scan_steps={K} batch={B} "
-                f"nnz={F} slots=2^{args.log2_slots} best={best*1e3:.1f}ms/{K}steps "
+                f"# {name}[{dist}]: device={jax.devices()[0]} scan_steps={K} batch={B_} "
+                f"nnz={F_} slots=2^{log2_slots} best={best*1e3:.1f}ms/{K}steps "
                 f"({best/K*1e6:.0f}µs/step) times_ms={[round(t*1e3,1) for t in times]}",
                 file=sys.stderr,
             )
-            rates[dist] = K * B / best
+            rates[dist] = K * B_ / best
         return rates
 
     kernel_parity = None
@@ -327,11 +355,18 @@ def main() -> int:
         kernel_parity = "ok"
 
     models = ["lr", "fm", "mvm"] if args.model == "all" else [args.model]
+
+    def model_shape(name: str) -> dict:
+        # FFM always benches at its practical shape (bench_model
+        # docstring) — also under an explicit --model ffm
+        if name == "ffm":
+            return {"batch": min(args.batch, 16384), "nnz": 18}
+        return {}
     # skewed-slot (Zipf alpha=1.05) runs ride along (round-1 verdict item
     # 9): real CTR id streams are heavy-tailed, and uniform slots are the
     # worst case for any dedup/caching lever — record both honestly
     dists = ("uniform",) if args.no_zipf else ("uniform", "zipf")
-    rates = {name: bench_model(name, dists) for name in models}
+    rates = {name: bench_model(name, dists, **model_shape(name)) for name in models}
     headline = "lr" if "lr" in rates else models[0]
     record = {
         "metric": f"{headline}_examples_per_sec",
@@ -356,6 +391,31 @@ def main() -> int:
         record["mvm_dupfields_vs_baseline"] = round(
             dup["uniform"] / PER_CHIP_TARGET, 3
         )
+    if args.model == "all":
+        # FFM companion (BASELINE.json config 5) at its practical shape
+        # (bench_model docstring): B=16k, 18 one-feature-per-field
+        # fields, k=4 — a [S, 73] fused row
+        ffm = bench_model("ffm", ("uniform",), **model_shape("ffm"))
+        record["ffm_examples_per_sec"] = round(ffm["uniform"], 1)
+        record["ffm_vs_baseline"] = round(ffm["uniform"] / PER_CHIP_TARGET, 3)
+        if args.log2_slots < 24 and not args.smoke:
+            # north-star table shape (round-3 verdict #2): 2^24 slots/chip
+            # = 1B features / 64 chips — the scale BASELINE.md's pod
+            # target implies; recorded so BENCH_r*.json can't flatter by
+            # benching only the smaller default shape
+            for name in models:
+                r24 = bench_model(name, ("uniform",), log2_slots=24)
+                record[f"{name}_s24_examples_per_sec"] = round(r24["uniform"], 1)
+                record[f"{name}_s24_vs_baseline"] = round(
+                    r24["uniform"] / PER_CHIP_TARGET, 3
+                )
+        if not args.smoke:
+            # end-to-end rider (round-3 verdict #5): disk → C++ parser →
+            # plan → device, the number `xflow train` actually delivers;
+            # the gap to the pre-staged headline is the host data plane
+            e2e_rate = measure_e2e(args, "fm", min(args.e2e_rows, 1_000_000))
+            record["e2e_fm_examples_per_sec"] = round(e2e_rate, 1)
+            record["e2e_fm_vs_baseline"] = round(e2e_rate / PER_CHIP_TARGET, 3)
     if kernel_parity is not None:
         record["kernel_parity"] = kernel_parity
     print(json.dumps(record))

@@ -172,11 +172,18 @@ def test_launch_local_two_process_sorted_engine(tmp_path, engine):
         "--set", "model.num_fields=4", "--set", "data.max_nnz=8",
         "--set", "train.pred_dump=false", "--set", "data.sorted_layout=on",
         "--set", f"data.sorted_mesh={engine}",
+        # exact eval on both sides: this is an equality gate, and the
+        # multi-process default (bucketed) differs by tie quantization
+        # on a 64-row test set
+        "--set", "train.eval_buckets=0",
     ]
     generate_shards(str(tmp_path / "train"), 2, rows, num_fields=4, ids_per_field=50)
+    generate_shards(str(tmp_path / "test"), 2, B, num_fields=4, ids_per_field=50,
+                    seed=7, truth_seed=0)
     r2 = run_cli(
         ["launch-local", "--num-processes", "2", "--",
-         "--train", str(tmp_path / "train"), "--batch-size", str(B),
+         "--train", str(tmp_path / "train"), "--test", str(tmp_path / "test"),
+         "--batch-size", str(B),
          "--checkpoint-dir", str(tmp_path / "ckpt2p"), *fm_args],
         tmp_path,
     )
@@ -187,14 +194,22 @@ def test_launch_local_two_process_sorted_engine(tmp_path, engine):
     _interleave_shards(
         [tmp_path / "train-00000", tmp_path / "train-00001"], B, tmp_path / "comb-00000"
     )
+    _interleave_shards(
+        [tmp_path / "test-00000", tmp_path / "test-00001"], B, tmp_path / "combtest-00000"
+    )
     r1 = run_cli(
-        ["train", "--train", str(tmp_path / "comb"), "--batch-size", str(2 * B),
+        ["train", "--train", str(tmp_path / "comb"), "--test", str(tmp_path / "combtest"),
+         "--batch-size", str(2 * B),
          "--checkpoint-dir", str(tmp_path / "ckpt1p"), "--no-mesh", *fm_args],
         tmp_path,
     )
     assert r1.returncode == 0, r1.stderr
     s1 = json.loads(r1.stdout.strip().splitlines()[-1])
     assert s1["steps"] == s2["steps"]
+    # the fullshard engine's multi-process eval consumes the host plan
+    # (sorted-plan eval, round-3 item 7) and must match the
+    # single-process eval on the composed test set
+    assert abs(s2["auc"] - s1["auc"]) < 1e-5, (s2["auc"], s1["auc"])
 
     d2 = np.load(tmp_path / "ckpt2p" / f"step_{s2['steps']}" / "state.npz")
     d1 = np.load(tmp_path / "ckpt1p" / f"step_{s1['steps']}" / "state.npz")
@@ -203,6 +218,71 @@ def test_launch_local_two_process_sorted_engine(tmp_path, engine):
         err_msg="2-process sorted-sharded tables != single-process sorted tables",
     )
     np.testing.assert_allclose(d2["opt/wv/n"], d1["opt/wv/n"], rtol=1e-5, atol=1e-6)
+
+
+def test_launch_local_two_process_mvm_auto_dup_coordination(tmp_path):
+    """ADVICE r3: multi-process MVM `mvm_exclusive=auto` must not raise
+    (or desync) on duplicate fields. Only rank 0's FIRST batch has a
+    row with a repeated field; the per-batch flag allgather must route
+    that batch to the segment mode on BOTH ranks (rank 1's rows are
+    clean) and the next batch back to the product mode — matching the
+    single-process auto run on the batch-composed data, which sees the
+    same duplicate pattern per global batch."""
+    B, rows = 32, 64
+    rng = np.random.default_rng(9)
+
+    def clean_row(label):
+        feats = " ".join(f"{fg}:{rng.integers(0, 50)}:1.0" for fg in range(4))
+        return f"{label}\t{feats}"
+
+    with open(tmp_path / "train-00000", "w") as f:
+        for i in range(rows):
+            if i < B:  # first batch: field 2 repeated -> duplicate
+                feats = " ".join(
+                    [f"2:{rng.integers(0, 50)}:1.0", f"2:{rng.integers(0, 50)}:1.0"]
+                    + [f"{fg}:{rng.integers(0, 50)}:1.0" for fg in (0, 1, 3)]
+                )
+                f.write(f"{i % 2}\t{feats}\n")
+            else:
+                f.write(clean_row(i % 2) + "\n")
+    with open(tmp_path / "train-00001", "w") as f:
+        for i in range(rows):
+            f.write(clean_row((i + 1) % 2) + "\n")
+
+    mvm_args = [
+        "--model", "mvm", "--epochs", "1", "--log2-slots", "13",
+        "--set", "model.num_fields=4", "--set", "data.max_nnz=8",
+        "--set", "train.pred_dump=false", "--set", "data.sorted_layout=on",
+        "--set", "data.sorted_mesh=fullshard",
+        "--set", "model.mvm_exclusive=auto",
+    ]
+    r2 = run_cli(
+        ["launch-local", "--num-processes", "2", "--",
+         "--train", str(tmp_path / "train"), "--batch-size", str(B),
+         "--checkpoint-dir", str(tmp_path / "ckpt2p"), *mvm_args],
+        tmp_path,
+    )
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    s2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert s2["steps"] == rows // B
+
+    _interleave_shards(
+        [tmp_path / "train-00000", tmp_path / "train-00001"], B, tmp_path / "comb-00000"
+    )
+    r1 = run_cli(
+        ["train", "--train", str(tmp_path / "comb"), "--batch-size", str(2 * B),
+         "--checkpoint-dir", str(tmp_path / "ckpt1p"), "--no-mesh", *mvm_args],
+        tmp_path,
+    )
+    assert r1.returncode == 0, r1.stderr
+    s1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    assert s1["steps"] == s2["steps"]
+    d2 = np.load(tmp_path / "ckpt2p" / f"step_{s2['steps']}" / "state.npz")
+    d1 = np.load(tmp_path / "ckpt1p" / f"step_{s1['steps']}" / "state.npz")
+    np.testing.assert_allclose(
+        d2["tables/v"], d1["tables/v"], rtol=1e-4, atol=1e-6,
+        err_msg="2-process mvm auto dup-coordination != single-process",
+    )
 
 
 def test_launch_local_two_process_fullshard_hot_key_fallback(tmp_path):

@@ -1,0 +1,92 @@
+"""Sanitizer runs for the native data plane (SURVEY.md §5 "race
+detection / sanitizers": the reference has none; our plan gives the one
+concurrent C++ component — the MT parser pool,
+native/parser.cc (mutex/condvar/atomics) — TSan and ASan+UBSan runs).
+
+Each case rebuilds parser.cc with `-fsanitize=...` (the flag joins the
+build-cache key, data/native.py _build_lib) and exercises the
+multi-threaded parser against the sequential one in a SUBPROCESS with
+the sanitizer runtime LD_PRELOADed (the host python is uninstrumented,
+so the runtime must be loaded first) and halt_on_error set: any data
+race / heap error / UB exits nonzero and fails the test. Auto-skips
+when the toolchain lacks the runtime libraries.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from xflow_tpu.data.synth import generate_shards
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _runtime_for(sanitize: str):
+    lib = "libtsan.so" if sanitize.startswith("thread") else "libasan.so"
+    try:
+        out = subprocess.run(
+            ["gcc", f"-print-file-name={lib}"], capture_output=True, text=True
+        ).stdout.strip()
+    except FileNotFoundError:
+        return None
+    return out if out and os.path.isabs(out) and os.path.exists(out) else None
+
+
+DRIVER = textwrap.dedent("""
+    import dataclasses, sys
+    import numpy as np
+    from xflow_tpu.config import DataConfig
+    from xflow_tpu.data.native import native_batch_iterator, native_count_rows
+    path = sys.argv[1]
+    seq = dataclasses.replace(
+        DataConfig(log2_slots=16, max_nnz=10),
+        parser_threads=1, block_bytes=4096,
+    )
+    mt = dataclasses.replace(seq, parser_threads=4)
+    a = list(native_batch_iterator(path, seq, 64))
+    b = list(native_batch_iterator(path, mt, 64))
+    assert len(a) == len(b) and len(a) > 0, (len(a), len(b))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.slots, y.slots)
+        np.testing.assert_array_equal(x.fields, y.fields)
+        np.testing.assert_array_equal(x.mask, y.mask)
+        np.testing.assert_array_equal(x.labels, y.labels)
+    assert native_count_rows(path, 4096) == sum(
+        int(x.row_mask.sum()) for x in a
+    )
+    print("SANITIZED_PARITY_OK", len(a))
+""")
+
+
+@pytest.mark.parametrize("sanitize", ["thread", "address,undefined"])
+def test_mt_parser_under_sanitizer(tmp_path, sanitize):
+    runtime = _runtime_for(sanitize)
+    if runtime is None:
+        pytest.skip(f"no sanitizer runtime for -fsanitize={sanitize}")
+    generate_shards(str(tmp_path / "train"), 1, 700, num_fields=7,
+                    ids_per_field=40, seed=3)
+    driver = tmp_path / "driver.py"
+    driver.write_text(DRIVER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["XFLOW_NATIVE_SANITIZE"] = sanitize
+    env["XFLOW_NATIVE_CACHE"] = str(tmp_path / "build")
+    env["LD_PRELOAD"] = runtime
+    # leak checking would flag the PYTHON interpreter's own allocations;
+    # the parser's handles are close()d explicitly, which IS exercised
+    env["ASAN_OPTIONS"] = "detect_leaks=0:halt_on_error=1:exitcode=66"
+    env["TSAN_OPTIONS"] = "halt_on_error=1:exitcode=66"
+    r = subprocess.run(
+        [sys.executable, str(driver), str(tmp_path / "train-00000")],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    if r.returncode != 0 and "cannot be preloaded" in (r.stderr or ""):
+        pytest.skip(f"sanitizer runtime not preloadable: {runtime}")
+    assert r.returncode == 0, (
+        f"-fsanitize={sanitize} run failed "
+        f"(rc={r.returncode})\nstdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    )
+    assert "SANITIZED_PARITY_OK" in r.stdout, r.stdout

@@ -53,22 +53,24 @@ def rand_batch(rng, nf=5):
     }
 
 
-def _place_fullshard(batch, cfg, mesh, mvm):
+def _place_fullshard(batch, cfg, mesh, with_fields):
     arrays = plan_fullshard_batch(
         batch["slots"], batch["mask"], cfg, mesh,
-        fields=batch["fields"] if mvm else None,
+        fields=batch["fields"] if with_fields else None,
     )
     arrays["labels"] = batch["labels"]
     arrays["row_mask"] = batch["row_mask"]
-    bsh = fullshard_batch_sharding(mesh, with_fields=mvm)
+    bsh = fullshard_batch_sharding(mesh, with_fields=with_fields)
     return {k: jax.device_put(jnp.asarray(v), bsh[k]) for k, v in arrays.items()}
 
 
-@pytest.mark.parametrize("model_name", ["fm", "mvm"])
+@pytest.mark.parametrize("model_name", ["fm", "mvm", "ffm"])
 @pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
 def test_fullshard_step_matches_single_device(model_name, mesh_shape):
     d, t = mesh_shape
-    cfg = cfg_for(model_name, d, t)
+    # ffm: k=3 keeps the fused row width (1 + nf*k = 16) CI-sized
+    extra = {"model.v_dim": 3} if model_name == "ffm" else {}
+    cfg = cfg_for(model_name, d, t, **extra)
     model, opt = get_model(model_name), get_optimizer("ftrl")
     rng = np.random.default_rng(0)
     batches = [rand_batch(rng) for _ in range(3)]
@@ -86,7 +88,9 @@ def test_fullshard_step_matches_single_device(model_name, mesh_shape):
     step2 = make_fullshard_train_step(opt, cfg, mesh)
     losses2 = []
     for b in batches:
-        state2, m = step2(state2, _place_fullshard(b, cfg, mesh, model_name == "mvm"))
+        state2, m = step2(
+            state2, _place_fullshard(b, cfg, mesh, model_name in ("mvm", "ffm"))
+        )
         losses2.append(float(m["loss"]))
 
     np.testing.assert_allclose(losses1, losses2, rtol=2e-5)
@@ -154,7 +158,7 @@ def test_fullshard_validation_messages():
     mesh = make_mesh(cfg_for("fm", 4, 2))
     with pytest.raises(ValueError, match="divisible by data\\*table\\*WINDOW"):
         validate_sorted_fullshard(cfg_for("fm", 4, 2, **{"data.log2_slots": 12}), mesh)
-    with pytest.raises(ValueError, match="fused FM and MVM"):
+    with pytest.raises(ValueError, match="fused FM, MVM, and FFM"):
         validate_sorted_fullshard(cfg_for("lr", 4, 2), mesh)
     with pytest.raises(ValueError, match="fm_fused"):
         validate_sorted_fullshard(
@@ -164,10 +168,11 @@ def test_fullshard_validation_messages():
     assert cap % 512 == 0 and cap >= 512
 
 
-@pytest.mark.parametrize("model_name", ["fm", "mvm"])
+@pytest.mark.parametrize("model_name", ["fm", "mvm", "ffm"])
 def test_trainer_fullshard_auto(model_name, tmp_path):
-    """Trainer on a mesh auto-selects the fullshard engine for FM/MVM
-    and trains to the same result as the single-device trainer."""
+    """Trainer on a mesh auto-selects the fullshard engine for
+    FM/MVM/FFM and trains to the same result as the single-device
+    trainer."""
     from xflow_tpu.data.synth import generate_shards
     from xflow_tpu.train.trainer import Trainer
 
@@ -180,6 +185,8 @@ def test_trainer_fullshard_auto(model_name, tmp_path):
         "train.pred_dump": False,
         "train.eval_buckets": 0,
     }
+    if model_name == "ffm":
+        over["model.v_dim"] = 3
     cfg = cfg_for(model_name, 4, 2, **over)
     mesh = make_mesh(cfg)
     t_mesh = Trainer(cfg, mesh=mesh)

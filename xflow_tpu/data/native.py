@@ -26,8 +26,18 @@ _LIB = None
 
 
 def _build_lib() -> str:
+    # XFLOW_NATIVE_SANITIZE=thread|address,undefined|… rebuilds the data
+    # plane under the named sanitizer(s) — the MT parser is the one
+    # concurrent C++ component (SURVEY.md §5 "race detection" plan;
+    # tests/test_native_sanitizers.py runs the MT parity check under
+    # TSan and ASan+UBSan). The flag value joins the cache key so
+    # sanitized and plain builds never collide; the host process must
+    # LD_PRELOAD the matching runtime before loading a sanitized .so.
+    sanitize = os.environ.get("XFLOW_NATIVE_SANITIZE", "")
     with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        digest = hashlib.sha256(
+            f.read() + sanitize.encode()
+        ).hexdigest()[:16]
     cache_dir = os.environ.get(
         "XFLOW_NATIVE_CACHE",
         os.path.join(os.path.dirname(_SRC), "_build"),
@@ -38,6 +48,8 @@ def _build_lib() -> str:
         return so_path
     tmp = tempfile.mktemp(suffix=".so", dir=cache_dir)
     cmd = ["g++", "-O3", "-std=c++17", "-pthread", "-shared", "-fPIC", "-o", tmp, _SRC]
+    if sanitize:
+        cmd[1:1] = [f"-fsanitize={sanitize}", "-g", "-fno-omit-frame-pointer"]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
     return so_path

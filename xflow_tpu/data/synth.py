@@ -24,6 +24,26 @@ def _planted_truth(truth_rng, num_fields, ids_per_field, truth_density):
     return truth
 
 
+def _planted_ffm_truth(truth_rng, num_fields, ids_per_field, dim=3):
+    """Field-PAIR interaction ground truth (BASELINE.json config 5's
+    learnability gate): per-feature latent u ∈ R^dim shared across
+    pairs, with an independent ±1 sign per unordered FIELD pair —
+    logit(row) = scale · Σ_{a<b} s_ab ⟨u_a[i_a], u_b[i_b]⟩.
+
+    The sign matrix is (with overwhelming probability for ≥3 fields)
+    NOT separable as s_ab = σ_a·σ_b, so a plain FM — whose ⟨v_i, v_j⟩
+    is field-blind — cannot represent the concept with the same latent
+    budget, while FFM fits it directly (v_{i,b} = ±u_i). `scale` keeps
+    logit variance ≈ num_fields, matching the linear truth's SNR."""
+    u = truth_rng.normal(0.0, 1.0, size=(num_fields, ids_per_field, dim))
+    s = np.triu(
+        np.where(truth_rng.random((num_fields, num_fields)) < 0.5, 1.0, -1.0), 1
+    )
+    n_pairs = num_fields * (num_fields - 1) // 2
+    scale = np.sqrt(num_fields / max(n_pairs * dim, 1))
+    return u, s, scale
+
+
 def _zipf_cdf(ids_per_field, zipf_alpha):
     if zipf_alpha <= 0.0:
         return None
@@ -45,6 +65,7 @@ def generate_shards(
     truth_density: float = 1.0,
     truth_seed: int | None = None,
     zipf_alpha: float = 0.0,
+    truth: str = "linear",
 ) -> list[str]:
     """Write `<out_prefix>-%05d` libffm shards; returns the paths.
 
@@ -60,10 +81,21 @@ def generate_shards(
     the worst case for gather locality and hides the wins from
     batch-level key dedup (BASELINE.md configs 2-3; round-1 verdict
     item 9). alpha≈1.1 approximates Criteo-like skew.
+
+    `truth="ffm"` plants the field-PAIR interaction concept
+    (`_planted_ffm_truth`) instead of the linear one — the learnability
+    gate for field-aware models (BASELINE.json config 5): FFM fits it
+    directly, a field-blind FM cannot with the same latent budget.
     """
     rng = np.random.default_rng(seed)
     truth_rng = np.random.default_rng(seed if truth_seed is None else truth_seed)
-    truth = _planted_truth(truth_rng, num_fields, ids_per_field, truth_density)
+    if truth not in ("linear", "ffm"):
+        raise ValueError(f"truth={truth!r}: expected linear|ffm")
+    ffm_truth = truth == "ffm"
+    if ffm_truth:
+        u, s_pairs, scale = _planted_ffm_truth(truth_rng, num_fields, ids_per_field)
+    else:
+        w_truth = _planted_truth(truth_rng, num_fields, ids_per_field, truth_density)
     value = 1.0 / np.sqrt(num_fields)
     zipf_cdf = _zipf_cdf(ids_per_field, zipf_alpha)
     paths = []
@@ -78,7 +110,14 @@ def generate_shards(
                     ids = np.searchsorted(zipf_cdf, rng.random(num_fields))
                 else:
                     ids = rng.integers(0, ids_per_field, size=num_fields)
-                logit = truth[np.arange(num_fields), ids].sum() + rng.normal(0.0, noise)
+                if ffm_truth:
+                    # Σ_{a<b} s_ab ⟨u_a[i_a], u_b[i_b]⟩ via one gram matrix
+                    ur = u[np.arange(num_fields), ids]  # [nf, d]
+                    logit = scale * float(
+                        (s_pairs * (ur @ ur.T)).sum()
+                    ) + rng.normal(0.0, noise)
+                else:
+                    logit = w_truth[np.arange(num_fields), ids].sum() + rng.normal(0.0, noise)
                 label = 1 if logit > 0 else 0
                 # feature-id strings are globalized per field (fg*ids_per_field
                 # + id): models hash the id token alone (as the reference does),

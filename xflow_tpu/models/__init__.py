@@ -1,4 +1,4 @@
 from xflow_tpu.models.base import Model, get_model, register_model
-from xflow_tpu.models import lr, fm, mvm  # noqa: F401  (registration side effects)
+from xflow_tpu.models import lr, fm, mvm, ffm  # noqa: F401  (registration side effects)
 
 __all__ = ["Model", "get_model", "register_model"]

@@ -88,20 +88,11 @@ def fm_logits_from_sums(sums, K, cfg):
     return wx + second
 
 
-def _forward_sorted_one(wv, sorted_slots, sorted_row, sorted_mask, win_off, rows, cfg):
-    from xflow_tpu.ops.sorted_table import (
-        pack_of,
-        row_sums_sorted,
-        table_gather_sorted,
-    )
-
-    from xflow_tpu.ops.sorted_table import wire_mask, wire_rows
+def _row_side_sorted(occ_t, sorted_row, sorted_mask, rows, cfg):
+    from xflow_tpu.ops.sorted_table import row_sums_sorted, wire_mask, wire_rows
 
     K = 1 + cfg.model.v_dim  # logical row width (storage may be packed)
     sorted_row, sorted_mask = wire_rows(sorted_row), wire_mask(sorted_mask)
-    occ_t = table_gather_sorted(
-        wv, sorted_slots, win_off, cfg.data.sorted_bf16, pack_of(wv, K)
-    )  # [K8, Np]
     # transposed throughout: [K8, Np] keeps the minor dim wide (full lanes)
     occm_t = occ_t[:K] * sorted_mask[None, :]
     stacked = stack_channels(occm_t, K)  # [ch, Np]
@@ -115,16 +106,16 @@ def _forward_sorted(tables, batch, cfg):
     windows with MXU one-hot matmuls (no random HBM access at table
     scale) and per-row sums cross through small [B, k] segment arrays.
     Sorted arrays may arrive stacked [NS, Np_sub] (plan_sorted_stacked):
-    map over row-contiguous sub-batches, same math (FM's row state is
-    already cache-resident at NS=1, so auto keeps NS=1)."""
-    from xflow_tpu.ops.sorted_table import map_sub_batches
+    the row side maps over row-contiguous sub-batches while the table
+    side runs once (sorted_gather_map; FM's row state is already
+    cache-resident at NS=1, so auto keeps NS=1)."""
+    from xflow_tpu.ops.sorted_table import sorted_gather_map
 
     wv = tables["wv"]
-    return map_sub_batches(
-        lambda ss, sr, sm, wo, rows: _forward_sorted_one(wv, ss, sr, sm, wo, rows, cfg),
-        batch,
-        ("sorted_slots", "sorted_row", "sorted_mask", "win_off"),
-        batch["labels"].shape[0],
+    return sorted_gather_map(
+        wv, batch, ("sorted_row", "sorted_mask"), batch["labels"].shape[0],
+        lambda occ, sr, sm, rows: _row_side_sorted(occ, sr, sm, rows, cfg),
+        1 + cfg.model.v_dim, cfg.data.sorted_bf16,
     )
 
 

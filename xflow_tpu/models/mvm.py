@@ -77,10 +77,13 @@ def has_field_duplicates(fields: np.ndarray, mask: np.ndarray) -> bool:
 def resolve_mvm_product(mvm_exclusive: str, has_dup: bool, num_processes: int) -> bool:
     """Route one batch: product path (True) or segment-sum path (False).
 
-    Multi-process runs cannot route per batch — the two paths have
-    different collective sequences, and ranks see different rows, so a
-    data-dependent choice would desync the SPMD programs. There (and
-    under `mvm_exclusive=on`) duplicate fields raise instead.
+    Callers: single-process routing (any engine) and `mvm_exclusive=on`
+    everywhere. The multi-process fullshard engine does NOT call this
+    under `auto` — it plans with fields and coordinates the per-batch
+    choice through a rank-symmetric flag allgather
+    (trainer._resolve_fullshard_overflow), so a local data-dependent
+    raise can never strand peer ranks in their collectives. Under `on`
+    duplicates raise by contract (the user asserted exclusive fields).
     """
     if mvm_exclusive == "off":
         return False
@@ -95,12 +98,12 @@ def resolve_mvm_product(mvm_exclusive: str, has_dup: bool, num_processes: int) -
                 "occurrences of the same field. Set model.mvm_exclusive=off "
                 "to use the segment-sum path"
                 + (
-                    " (multi-process runs cannot fall back per batch: the two "
-                    "paths' collective sequences differ across ranks. This "
-                    "check sees only THIS rank's rows, so peer ranks that hit "
-                    "no duplicate will sit in their collective until the job "
-                    "timeout — pre-validate multi-valued-field data, or set "
-                    "mvm_exclusive=off up front)"
+                    " (this multi-process configuration cannot fall back per "
+                    "batch: the two paths' collective sequences differ across "
+                    "ranks — only the fullshard engine's `auto` coordinates "
+                    "the choice. Peer ranks that hit no duplicate may block "
+                    "in their collectives until the launcher's fail-fast "
+                    "teardown — set mvm_exclusive=off up front)"
                     if num_processes > 1
                     else ""
                 )
@@ -199,54 +202,36 @@ def make_row_products(reduce_rows, broadcast_rows, k: int):
     return op
 
 
-def _forward_sorted_one(v, sorted_slots, sorted_row, sorted_mask, sorted_fields,
-                        win_off, rows, nf, k, bf16=False, plus=0.0):
-    """One sub-batch: [K8, Np] windowed gather + one segment-sum keyed on
-    `row * nf + field` → logits [rows]. `k` is the LOGICAL latent dim
-    (storage may be packed, ops/sorted_table.pack_table)."""
+def _segment_row_side(occ_t, sorted_row, sorted_mask, sorted_fields,
+                      rows, nf, k, plus=0.0):
+    """One sub-batch's row side from raw gathered rows: one segment-sum
+    keyed on `row * nf + field` → logits [rows]."""
     from xflow_tpu.ops.sorted_table import (
-        pack_of,
-        table_gather_sorted,
+        segment_sum_channels,
         wire_mask,
         wire_rows,
     )
 
     sorted_row, sorted_mask = wire_rows(sorted_row), wire_mask(sorted_mask)
     seg = sorted_row * nf + wire_rows(sorted_fields)  # [Np]
-    occ_t = table_gather_sorted(
-        v, sorted_slots, win_off, bf16, pack_of(v, k)
-    )  # [K8, Np]
     occm_t = occ_t[:k] * sorted_mask[None, :]
     # stack the mask as one extra channel: its segment-sum is the
     # per-(row, field) occurrence count, giving `present` in the same op
     stacked = jnp.concatenate([occm_t, sorted_mask[None, :]], axis=0)  # [k+1, Np]
-    sums_t = jax.vmap(
-        lambda r: jax.ops.segment_sum(r, seg, num_segments=rows * nf)
-    )(stacked)  # [k+1, rows*nf]
-    s = sums_t[:k].reshape(k, rows, nf)
-    present = (sums_t[k] > 0).reshape(rows, nf)
-    factors = jnp.where(present[None, :, :], s + plus, 1.0)  # [k, rows, nf]
-    return jnp.prod(factors, axis=-1).sum(axis=0)  # [rows]
+    sums = segment_sum_channels(stacked, seg, rows * nf)  # [rows*nf, k+1]
+    s = sums[:, :k].reshape(rows, nf, k)
+    present = (sums[:, k] > 0).reshape(rows, nf)
+    factors = jnp.where(present[..., None], s + plus, 1.0)  # [rows, nf, k]
+    return jnp.prod(factors, axis=1).sum(axis=-1)  # [rows]
 
 
-def _forward_sorted_product_one(v, sorted_slots, sorted_row, sorted_mask,
-                                win_off, rows, k, bf16=False, plus=0.0):
-    """One sub-batch on the exclusive-fields product path: windowed
-    gather + the SAME [rows, ~32] row-sum kernel FM uses — no
-    per-(row, field) segment space exists at all. `k` = logical latent
-    dim (storage may be packed)."""
-    from xflow_tpu.ops.sorted_table import (
-        pack_of,
-        row_sums_sorted,
-        table_gather_sorted,
-        wire_mask,
-        wire_rows,
-    )
+def _product_row_side(occ_t, sorted_row, sorted_mask, rows, k, plus=0.0):
+    """One sub-batch's row side on the exclusive-fields product path:
+    the SAME [rows, ~32] row-sum kernel FM uses — no per-(row, field)
+    segment space exists at all."""
+    from xflow_tpu.ops.sorted_table import row_sums_sorted, wire_mask, wire_rows
 
     sorted_row, sorted_mask = wire_rows(sorted_row), wire_mask(sorted_mask)
-    occ_t = table_gather_sorted(
-        v, sorted_slots, win_off, bf16, pack_of(v, k)
-    )  # [K8, Np]
     op = make_row_products(
         lambda stacked, rows_: row_sums_sorted(stacked, rows_, rows),
         lambda arr: arr,
@@ -273,32 +258,31 @@ def _forward_sorted(tables, batch, cfg):
       [B·nf, k+1] aggregate falls out of cache at B=64k (the backward
       gather was the measured MVM wall, docs/PERF.md 3a), so sorted
       arrays may arrive STACKED [NS, Np_sub] (`plan_sorted_stacked`) and
-      the forward maps over row-contiguous sub-batches; XLA accumulates
-      the table cotangent across the map. NS-invariant math either way.
+      the ROW side maps over row-contiguous sub-batches — the table
+      side runs as ONE window-major multi-buffer gather/scatter
+      (`sorted_gather_map`), so the table crosses HBM once per step,
+      not once per sub-batch. NS-invariant math either way.
     """
-    from xflow_tpu.ops.sorted_table import map_sub_batches
+    from xflow_tpu.ops.sorted_table import sorted_gather_map
 
     v = tables["v"]
     bf16 = cfg.data.sorted_bf16
     plus = 1.0 if cfg.model.mvm_plus_one else 0.0
     k = cfg.model.v_dim
+    B = batch["labels"].shape[0]
     if "sorted_fields" not in batch:
-        return map_sub_batches(
-            lambda ss, sr, sm, wo, rows: _forward_sorted_product_one(
-                v, ss, sr, sm, wo, rows, k, bf16, plus
-            ),
-            batch,
-            ("sorted_slots", "sorted_row", "sorted_mask", "win_off"),
-            batch["labels"].shape[0],
+        return sorted_gather_map(
+            v, batch, ("sorted_row", "sorted_mask"), B,
+            lambda occ, sr, sm, rows: _product_row_side(occ, sr, sm, rows, k, plus),
+            k, bf16,
         )
     nf = cfg.model.num_fields
-    return map_sub_batches(
-        lambda ss, sr, sm, sf, wo, rows: _forward_sorted_one(
-            v, ss, sr, sm, sf, wo, rows, nf, k, bf16, plus
+    return sorted_gather_map(
+        v, batch, ("sorted_row", "sorted_mask", "sorted_fields"), B,
+        lambda occ, sr, sm, sf, rows: _segment_row_side(
+            occ, sr, sm, sf, rows, nf, k, plus
         ),
-        batch,
-        ("sorted_slots", "sorted_row", "sorted_mask", "sorted_fields", "win_off"),
-        batch["labels"].shape[0],
+        k, bf16,
     )
 
 

@@ -389,21 +389,33 @@ def plan_sorted_stacked(
     )
 
 
-def map_sub_batches(fn, batch: dict, keys: tuple, batch_rows: int):
-    """Dispatch a sorted-path forward over flat or stacked plans.
+def sorted_gather_map(table, batch: dict, row_keys: tuple, batch_rows: int,
+                      row_fn, K: int, bf16: bool):
+    """Gather the table ONCE, then map the row side over sub-batches.
 
-    `fn(*arrays, rows)` computes logits for one sub-batch from the
-    per-occurrence arrays named by `keys`. Flat ([Np]) plans call it
-    once; stacked ([NS, Np_sub], `plan_sorted_stacked`) map it over the
-    row-contiguous sub-batches and re-concatenate — row order is
-    preserved, so the result is NS-invariant.
+    `row_fn(occ_t [K8, Np], *row_arrays, rows)` computes logits for one
+    sub-batch from its raw gathered rows. Flat plans use the
+    single-stream gather; stacked plans ([NS, Np_sub],
+    `plan_sorted_stacked`) run ONE `table_gather_sorted_multi` over the
+    concatenated streams — window-major, so the table (and its gradient
+    blocks in the VJP) crosses HBM exactly once per step instead of
+    once per sub-batch. Before this, NS=4 sub-batching re-read the
+    whole table 4× each direction — the dominant cost of the MVM
+    segment path (docs/PERF.md 3a).
     """
-    arrs = tuple(batch[k] for k in keys)
-    if arrs[0].ndim == 1:
-        return fn(*arrs, batch_rows)
-    ns = arrs[0].shape[0]
+    pack = pack_of(table, K)
+    ss, wo = batch["sorted_slots"], batch["win_off"]
+    arrs = tuple(batch[k] for k in row_keys)
+    if ss.ndim == 1:
+        occ_t = table_gather_sorted(table, ss, wo, bf16, pack)
+        return row_fn(occ_t, *arrs, batch_rows)
+    ns, np_sub = ss.shape
     rows = batch_rows // ns
-    logits = jax.lax.map(lambda a: fn(*a, rows), arrs)  # [NS, rows]
+    occ_all = table_gather_sorted_multi(table, ss.reshape(-1), wo, bf16, pack)
+    occ_ns = occ_all.reshape(occ_all.shape[0], ns, np_sub).transpose(1, 0, 2)
+    logits = jax.lax.map(
+        lambda a: row_fn(a[0], *a[1:], rows), (occ_ns, *arrs)
+    )  # [NS, rows]
     return logits.reshape(batch_rows)
 
 
@@ -446,6 +458,10 @@ def resolve_sub_batches(cfg) -> int:
         return ns
     if cfg.model.name == "mvm" and cfg.model.mvm_exclusive == "off":
         per_row = cfg.model.num_fields * (cfg.model.v_dim + 1) * 4
+        return auto_sub_batches(B, per_row)
+    if cfg.model.name == "ffm":
+        # FFM's per-(row, field) aggregate is [B/NS·nf, nf·k+2]
+        per_row = cfg.model.num_fields * (cfg.model.num_fields * cfg.model.v_dim + 2) * 4
         return auto_sub_batches(B, per_row)
     return 1
 
@@ -560,34 +576,32 @@ def _windowed_select(table_block, rel, pack: int, bf16: bool):
     return occ
 
 
-def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_d,
-                   sem_o, *, bf16, n_tw, pack):
-    """Triple-buffered windowed gather: the chunk chain is DMA-LATENCY
-    bound, not bandwidth bound (~460 MB of traffic measured ~18 ms
-    serialized = ~4 us/chunk of waits), so inputs for chunk c+2 prefetch
-    during compute of c and the output copy of c drains while c+1 and
-    c+2 run. Buffer sel = c % 3; `old[sel]` is both the blend source and
-    the out staging, so its input copy for c+2 waits the out copy of
-    c-1 (same buffer). The epilogue drains the three out copies still in
-    flight (n-3, n-2, n-1 — one per buffer); grid steps are sequential,
-    so the next window (whose aligned chunk range can overlap this
-    one's) never races these writes."""
+def _gather_span(slots_ref, out_ref, table_ref, slc, old, sem_s, sem_d, sem_o,
+                 base, start, end, bf16, pack):
+    """NB-deep pipelined windowed gather of ONE occurrence span [start,
+    end) against the table block at `base` (NB = the scratch buffer
+    count, `PIPE_NB`): the chunk chain is DMA-LATENCY bound, not
+    bandwidth bound (~460 MB of traffic measured ~18 ms serialized =
+    ~4 us/chunk of waits), so inputs for chunk c+NB-1 prefetch during
+    compute of c and the output copy of c drains while later chunks
+    run. Buffer sel = c % NB; `old[sel]` is both the blend source and
+    the out staging, so its input copy for c+NB-1 waits the out copy of
+    c-1 (same buffer). The epilogue drains the min(n, NB) out copies
+    still in flight (one per buffer); spans run sequentially (grid
+    steps / the multi kernel's buffer loop), so the next span (whose
+    aligned chunk range can overlap this one's) never races these
+    writes. Shared by the single-stream and multi-buffer gather
+    kernels — a fix here fixes both."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    t = pl.program_id(0)
+    NB = old.shape[0]  # pipeline depth = scratch buffer count
     K = table_ref.shape[1] // pack
-    # t % n_tw: the grid may sweep the table's windows SEVERAL times (the
-    # fully-sharded engine concatenates D per-source-shard occurrence
-    # buffers that each span the same local table shard); in the
-    # single-stream case the grid size equals n_tw and this is identity
-    base = (t % n_tw) * WINDOW
-    start, end = off_ref[t], off_ref[t + 1]
     astart = (start // CHUNK) * CHUNK  # aligned down: extras self-mask
     n_chunks = pl.cdiv(end - astart, CHUNK)
 
     def in_copies(c):
-        sel = c % 3
+        sel = c % NB
         o = astart + c * CHUNK
         return (
             pltpu.make_async_copy(
@@ -599,7 +613,7 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_
         )
 
     def out_copy(c):
-        sel = c % 3
+        sel = c % NB
         o = astart + c * CHUNK
         return pltpu.make_async_copy(
             old.at[sel], out_ref.at[:, pl.ds(o, CHUNK)], sem_o.at[sel]
@@ -610,16 +624,13 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_
         cs.start()
         co.start()
 
-    @pl.when(n_chunks > 0)
-    def _():
-        start_in(0)
-
-    @pl.when(n_chunks > 1)
-    def _():
-        start_in(1)
+    for i in range(NB - 1):
+        @pl.when(n_chunks > i)
+        def _(i=i):
+            start_in(i)
 
     def chunk_step(c, carry):
-        sel = c % 3
+        sel = c % NB
         cs, co = in_copies(c)
         cs.wait()
         rel = slc[sel][0:1, :] - base  # [1, C]
@@ -632,40 +643,77 @@ def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_
         co.wait()
         in_win = (rel >= 0) & (rel < WINDOW)  # [1, C]
         # blend: positions whose slot is outside this window belong to a
-        # neighboring window's chunks — keep whatever is already there
+        # neighboring window's (or buffer's) chunks — keep what is there
         pad = jnp.zeros((old.shape[1] - K, CHUNK), jnp.float32)
         old[sel] = jnp.where(in_win, jnp.concatenate([occ, pad], axis=0), old[sel])
         out_copy(c).start()
 
-        @pl.when(c + 2 < n_chunks)
+        @pl.when(c + NB - 1 < n_chunks)
         def _():
-            # old[(c+2)%3] was the out staging of chunk c-1: drain that
-            # copy before overwriting the buffer
+            # old[(c+NB-1)%NB] was the out staging of chunk c-1: drain
+            # that copy before overwriting the buffer
             @pl.when(c >= 1)
             def _():
                 out_copy(c - 1).wait()
 
-            start_in(c + 2)
+            start_in(c + NB - 1)
 
         return carry
 
     jax.lax.fori_loop(0, n_chunks, chunk_step, 0)
 
     # drain every out copy not waited in-loop: iteration c waits out(c-1)
-    # only while prefetching (c+2 < n), so outs n-3, n-2, n-1 (one per
-    # buffer) are still in flight here — an unwaited DMA would leave its
-    # semaphore signaled and corrupt the next grid step
-    @pl.when(n_chunks > 2)
-    def _():
-        out_copy(n_chunks - 3).wait()
+    # only while prefetching (c+NB-1 < n), so the last min(n, NB) outs
+    # (one per buffer) are still in flight here — an unwaited DMA would
+    # leave its semaphore signaled and corrupt the next span
+    for i in range(NB, 0, -1):
+        @pl.when(n_chunks > i - 1)
+        def _(i=i):
+            out_copy(n_chunks - i).wait()
 
-    @pl.when(n_chunks > 1)
-    def _():
-        out_copy(n_chunks - 2).wait()
 
-    @pl.when(n_chunks > 0)
-    def _():
-        out_copy(n_chunks - 1).wait()
+def _gather_kernel(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s, sem_d,
+                   sem_o, *, bf16, n_tw, pack):
+    """Single-stream windowed gather: grid step t owns logical window
+    t % n_tw (identity when the stream covers the table once)."""
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(0)
+    _gather_span(
+        slots_ref, out_ref, table_ref, slc, old, sem_s, sem_d, sem_o,
+        (t % n_tw) * WINDOW, off_ref[t], off_ref[t + 1], bf16, pack,
+    )
+
+
+def _gather_kernel_multi(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s,
+                         sem_d, sem_o, *, bf16, nbuf, cap, pack):
+    """Windowed gather over `nbuf` concatenated per-source buffers,
+    WINDOW-MAJOR: grid step j owns table window j and walks every
+    buffer's matching span, so each table block is DMA'd into VMEM
+    exactly ONCE per call instead of once per buffer — the source-major
+    order read the whole table nbuf times (nbuf = D source shards in
+    the fullshard engine, NS sub-batches on one device; measured 2×+ on
+    the MVM segment path at NS=4). `off_ref` is [nbuf, wpo+1]
+    buffer-local window offsets, the `_scatter_kernel_multi` contract."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(0)
+
+    def buf_step(i, carry):
+        _gather_span(
+            slots_ref, out_ref, table_ref, slc, old, sem_s, sem_d, sem_o,
+            j * WINDOW, i * cap + off_ref[i, j], i * cap + off_ref[i, j + 1],
+            bf16, pack,
+        )
+        return carry
+
+    jax.lax.fori_loop(0, nbuf, buf_step, 0)
+
+
+PIPE_NB = 6  # gather chunk-chain pipeline depth (buffers); the chain is
+# DMA-latency bound (_gather_span), so deeper prefetch hides more of the
+# per-chunk wait — 6 measured best vs 3 on v5e at bench shapes; VMEM cost
+# is NB × (K8+1) × CHUNK × 4 B ≈ 70 KB, noise
 
 
 def _gather_pallas(table, sorted_slots, win_off, bf16=False, pack=1):
@@ -689,11 +737,11 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False, pack=1):
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),  # occ_t [K8, Np]
         scratch_shapes=[
-            pltpu.VMEM((3, 1, CHUNK), jnp.int32),  # slc, triple-buffered
-            pltpu.VMEM((3, K8, CHUNK), jnp.float32),  # old/staging
-            pltpu.SemaphoreType.DMA((3,)),
-            pltpu.SemaphoreType.DMA((3,)),
-            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.VMEM((PIPE_NB, 1, CHUNK), jnp.int32),  # slc, pipelined
+            pltpu.VMEM((PIPE_NB, K8, CHUNK), jnp.float32),  # old/staging
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
         ],
     )
     return pl.pallas_call(
@@ -702,6 +750,42 @@ def _gather_pallas(table, sorted_slots, win_off, bf16=False, pack=1):
         out_shape=jax.ShapeDtypeStruct((K8, n), jnp.float32),
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
     )(win_off, sorted_slots.reshape(1, n), table)
+
+
+def _gather_pallas_multi(table, sorted_slots, loc_off, cap, bf16=False, pack=1):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Sp, Kp = table.shape
+    K = Kp // pack
+    K8 = _k8(K)
+    n_win = Sp * pack // WINDOW
+    nbuf, wpo1 = loc_off.shape
+    n = sorted_slots.shape[0]
+    assert wpo1 == n_win + 1, (loc_off.shape, n_win)
+    assert cap % CHUNK == 0 and nbuf * cap == n, (nbuf, cap, n)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_win,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
+            pl.BlockSpec((WINDOW // pack, Kp), lambda t, off: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),  # occ_t [K8, Np]
+        scratch_shapes=[
+            pltpu.VMEM((PIPE_NB, 1, CHUNK), jnp.int32),  # slc, pipelined
+            pltpu.VMEM((PIPE_NB, K8, CHUNK), jnp.float32),  # old/staging
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+        ],
+    )
+    return pl.pallas_call(
+        partial(_gather_kernel_multi, bf16=bf16, nbuf=nbuf, cap=cap, pack=pack),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((K8, n), jnp.float32),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(loc_off, sorted_slots.reshape(1, n), table)
 
 
 def _scatter_span(slots_ref, d_ref, slc, dch, sem_s, sem_d, base, start, end,
@@ -713,16 +797,19 @@ def _scatter_span(slots_ref, d_ref, slc, dch, sem_s, sem_d, base, start, end,
     fixes both). Packed expands the [K, C] cotangent chunk to
     [pack*K, C] with `pack` static 0/1-masked block copies (exact) and
     contracts against the PACKED one-hot — pack× fewer MXU MACs per
-    chunk. Triple-buffered: chunk c+2's inputs prefetch during compute
-    of c (the chain is DMA-latency bound, like the gather's)."""
+    chunk. NB-deep pipelined (NB = scratch buffer count, `PIPE_NB`):
+    chunk c+NB-1's inputs prefetch during compute of c (the chain is
+    DMA-latency bound, like the gather's)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     astart = (start // CHUNK) * CHUNK
     n_chunks = pl.cdiv(end - astart, CHUNK)
 
+    NB = dch.shape[0]  # pipeline depth = scratch buffer count
+
     def in_copies(c):
-        sel = c % 3
+        sel = c % NB
         o = astart + c * CHUNK
         return (
             pltpu.make_async_copy(
@@ -738,23 +825,20 @@ def _scatter_span(slots_ref, d_ref, slc, dch, sem_s, sem_d, base, start, end,
         cs.start()
         cd.start()
 
-    @pl.when(n_chunks > 0)
-    def _():
-        start_in(0)
-
-    @pl.when(n_chunks > 1)
-    def _():
-        start_in(1)
+    for i in range(NB - 1):
+        @pl.when(n_chunks > i)
+        def _(i=i):
+            start_in(i)
 
     def chunk_step(c, acc):
-        sel = c % 3
+        sel = c % NB
         cs, cd = in_copies(c)
         cs.wait()
         cd.wait()
 
-        @pl.when(c + 2 < n_chunks)
+        @pl.when(c + NB - 1 < n_chunks)
         def _():
-            start_in(c + 2)
+            start_in(c + NB - 1)
 
         rel = slc[sel][0:1, :] - base  # [1, C]; out-of-window: no lane
         if pack == 1:
@@ -811,10 +895,10 @@ def _scatter_pallas(d_occ_t, sorted_slots, win_off, num_slots, k: int, bf16=Fals
         ],
         out_specs=pl.BlockSpec((WINDOW // pack, pack * k), lambda t, off: (t, 0)),
         scratch_shapes=[
-            pltpu.VMEM((3, 1, CHUNK), jnp.int32),
-            pltpu.VMEM((3, K8, CHUNK), jnp.float32),
-            pltpu.SemaphoreType.DMA((3,)),
-            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.VMEM((PIPE_NB, 1, CHUNK), jnp.int32),
+            pltpu.VMEM((PIPE_NB, K8, CHUNK), jnp.float32),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
         ],
     )
     return pl.pallas_call(
@@ -874,10 +958,10 @@ def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap,
         ],
         out_specs=pl.BlockSpec((WINDOW // pack, pack * k), lambda t, off: (t, 0)),
         scratch_shapes=[
-            pltpu.VMEM((3, 1, CHUNK), jnp.int32),
-            pltpu.VMEM((3, K8, CHUNK), jnp.float32),
-            pltpu.SemaphoreType.DMA((3,)),
-            pltpu.SemaphoreType.DMA((3,)),
+            pltpu.VMEM((PIPE_NB, 1, CHUNK), jnp.int32),
+            pltpu.VMEM((PIPE_NB, K8, CHUNK), jnp.float32),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
         ],
     )
     return pl.pallas_call(
@@ -986,6 +1070,43 @@ def _rowsum_bwd(num_rows, rows, d_out):
 row_sums_sorted.defvjp(_rowsum_fwd, _rowsum_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def segment_sum_channels(vals_t, seg, num_segments):
+    """Σ over occurrences into segments: out[s, c] = Σ_{j: seg[j]=s} vals_t[c, j].
+
+    The SEGMENT-space counterpart of `row_sums_sorted` for row sides too
+    large for the VMEM accumulator (MVM's and FFM's [B·nf] per-(row,
+    field) spaces). Forward is XLA's per-channel scatter-add; the win is
+    the BACKWARD: the plain VJP gathers ch-wide rows from the [S, ch]
+    cotangent, whose (8, 128)-tiled HBM layout serves ch/128 useful
+    lanes per line. Here the bwd gathers PACK-row groups from the free
+    [S/PACK, PACK·ch] reshape — full 512 B lines — and sub-selects
+    elementwise (`_sub_select`, never a matmul: gradients stay exact).
+    Bench-level effect: MVM dupfields 651k → ~705k ex/s (the remaining
+    wall is the forward scatter-add itself — docs/PERF.md 3a). Falls
+    back to the plain gather when S % PACK != 0."""
+    sums = jax.vmap(
+        lambda r: jax.ops.segment_sum(r, seg, num_segments=num_segments)
+    )(vals_t)
+    return sums.T  # [S, ch]
+
+
+def _ssc_fwd(vals_t, seg, num_segments):
+    return segment_sum_channels(vals_t, seg, num_segments), seg
+
+
+def _ssc_bwd(num_segments, seg, d_out):
+    ch = d_out.shape[1]
+    if num_segments % PACK:
+        return jnp.take(d_out, seg, axis=0).T, None
+    grouped = d_out.reshape(num_segments // PACK, PACK * ch)
+    rows = jnp.take(grouped, seg // PACK, axis=0)  # [Np, PACK*ch]
+    return _sub_select(rows, seg % PACK, PACK, ch).T, None
+
+
+segment_sum_channels.defvjp(_ssc_fwd, _ssc_bwd)
+
+
 # ------------------------------------------------------------ public op
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -1031,40 +1152,31 @@ table_gather_sorted.defvjp(_gather_fwd, _gather_bwd)
 
 # ------------------------------------------- multi-buffer op (fullshard)
 
-def _multi_off_flat(loc_off, cap):
-    """[nbuf, wpo+1] buffer-local offsets -> [nbuf*wpo + 1] positions in
-    the concatenated stream. loc_off[i, 0] == 0 and loc_off[i, wpo] ==
-    cap (host contract: pads are owned by the last window), so the
-    intervals are consecutive and cover [0, nbuf*cap) exactly."""
-    nbuf, wpo1 = loc_off.shape
-    wpo = wpo1 - 1
-    starts = jnp.arange(nbuf, dtype=jnp.int32)[:, None] * cap + loc_off[:, :wpo]
-    return jnp.concatenate(
-        [starts.reshape(-1), jnp.array([nbuf * cap], jnp.int32)]
-    )
-
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def table_gather_sorted_multi(table, sorted_slots, loc_off, bf16=False, pack=1):
     """`table_gather_sorted` over a concatenated multi-buffer stream: the
-    fully-sharded engine's per-device input is `nbuf` fixed-capacity
-    buffers (one per source data shard, each slot-sorted over THIS
-    device's local table shard, pads at slot S_local-1 / mask 0). The
-    gather sweeps the local windows once per buffer (wrap-around window
-    indexing); the VJP accumulates every buffer's span into one [W, K]
-    block write per local window (`_scatter_kernel_multi`) — the
-    table-shard gradient never leaves the device.
+    per-call input is `nbuf` fixed-capacity buffers, each slot-sorted
+    over the SAME table (the fullshard engine's per-source-shard buffers
+    over the local shard, pads at slot S_local-1 / mask 0; a single
+    device's NS row-contiguous sub-batch plans over the whole table).
+    Both directions are WINDOW-MAJOR — grid step j owns table window j
+    and walks every buffer's matching span — so the table crosses
+    HBM→VMEM exactly ONCE per call regardless of nbuf (the source-major
+    order read it nbuf times; measured 2×+ on the MVM segment path at
+    NS=4). The VJP accumulates every buffer's span into one [W, K]
+    block write per window (`_scatter_kernel_multi`); in the fullshard
+    engine the table-shard gradient never leaves the device.
 
     `loc_off` [nbuf, wpo+1]: buffer-local window offsets, last entry
     extended to `cap`. Capacity = sorted_slots.size // nbuf, a CHUNK
-    multiple (host contract, parallel/sorted_fullshard.py). `pack` as
-    in `table_gather_sorted` (the local shard stored [S_l/pack,
-    pack*K])."""
+    multiple (host contract: parallel/sorted_fullshard.py buffers, or
+    `plan_sorted_stacked` sub-batch plans via `sorted_gather_map`).
+    `pack` as in `table_gather_sorted` (the table stored
+    [S/pack, pack*K])."""
     if _on_tpu():
         cap = sorted_slots.shape[0] // loc_off.shape[0]
-        return _gather_pallas(
-            table, sorted_slots, _multi_off_flat(loc_off, cap), bf16, pack
-        )
+        return _gather_pallas_multi(table, sorted_slots, loc_off, cap, bf16, pack)
     return _gather_xla(table, sorted_slots, None, pack)
 
 

@@ -22,10 +22,12 @@ Data flow per step, device (d, t), owner block o = d*T + t:
    traffic (~12 B/occurrence · slack), the synchronous analog of every
    worker Pulling from the server that owns each key
    (`lr_worker.cc:170`), batched into one collective.
-3. The Pallas sorted-window kernels run UNMODIFIED on the local
-   ``[S/(D*T), K]`` table shard over the concatenated buffer stream
-   (`table_gather_sorted_multi`: wrap-around window indexing; the VJP
-   accumulates all buffers into one block write per local window).
+3. The Pallas sorted-window kernels run on the local ``[S/(D*T), K]``
+   table shard over the concatenated buffer stream
+   (`table_gather_sorted_multi`: WINDOW-MAJOR in both directions —
+   each grid step owns one table window and walks every source
+   buffer's span, so the shard crosses HBM→VMEM once per call; the
+   VJP accumulates all buffers into one block write per local window).
 4. Per-row partial sums for ALL source shards are reduced to their row
    owners by ONE `psum_scatter` over 'data' + ONE `psum` over 'table'
    (~B·ch·4 B each) — aggregated rows cross the wire, never table rows.
@@ -40,9 +42,11 @@ key). `data.fullshard_slack` sizes the buffers; overflow fails loudly
 at plan time with the slack to raise. Host-side dedup shrinks exactly
 this traffic on skewed data (docs/PERF.md lever 4).
 
-Supports fused FM and MVM (sorted-engine models). LR stays on the GSPMD
-row-major path: its 1-D table gather is already bandwidth-efficient
-(2.2× the per-chip target, BENCH_r02) and needs no windowed engine.
+Supports fused FM, MVM, and FFM (sorted-engine models; FFM rides the
+MVM segment mode's machinery with its own channel contract —
+models/ffm.py). LR stays on the GSPMD row-major path: its 1-D table
+gather is already bandwidth-efficient (2.2× the per-chip target,
+BENCH_r02) and needs no windowed engine.
 """
 
 from __future__ import annotations
@@ -100,10 +104,10 @@ def validate_sorted_fullshard(cfg: Config, mesh: Mesh) -> None:
     if cfg.model.name == "fm":
         if not cfg.model.fm_fused:
             raise ValueError("fullshard FM needs model.fm_fused=true (one table)")
-    elif cfg.model.name != "mvm":
+    elif cfg.model.name not in ("mvm", "ffm"):
         raise ValueError(
-            "fullshard layout supports fused FM and MVM (LR keeps the GSPMD "
-            f"row-major path); got model={cfg.model.name}"
+            "fullshard layout supports fused FM, MVM, and FFM (LR keeps the "
+            f"GSPMD row-major path); got model={cfg.model.name}"
         )
     if d % p != 0:
         raise ValueError(
@@ -260,6 +264,196 @@ def fullshard_batch_sharding(mesh: Mesh, with_fields: bool = False) -> dict:
     return {k: full[k] for k in keys}
 
 
+def _local_logits(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
+                  R, cfg, D, K, nf, bf16, plus):
+    """Device (d, t) forward body, shared by the train and eval steps.
+
+    tbl_local [S/(D*T)/pack, pack*K]; fs_* are MY source shard's buffers
+    for column t, [D_dst, cap]; returns logits [R] for MY data
+    coordinate's rows. Storage may be packed
+    (ops/sorted_table.pack_table) — detected from the shard's shape,
+    slot indices stay logical.
+
+    Steps (the numbers refer to the module docstring's data flow):
+    2. exchange: my buffer for dest d' -> device (d', t); receive every
+       source's buffer for MY block — ONE all_to_all over 'data'.
+    3. local windowed gather (+ shard-local scatter in the VJP).
+    4. per-row aggregates return to their row owners: psum_scatter over
+       'data' + psum over 'table' (owner_reduce).
+    """
+    from xflow_tpu.ops.sorted_table import pack_of, wire_mask, wire_rows
+
+    def a2a(x):
+        return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
+
+    r_slots = a2a(fs_slots)  # [D_src, cap]
+    # compacted wire dtypes (compact_plan_wire) ride through the
+    # all_to_all — less ICI traffic too — and upcast after
+    r_row = wire_rows(a2a(fs_row))
+    r_mask = wire_mask(a2a(fs_mask))
+    r_off = a2a(fs_off)  # [D_src, wpo+1]
+    slots_flat = r_slots.reshape(-1)
+    mask_flat = jax.lax.stop_gradient(r_mask.reshape(-1))
+
+    occ_t = table_gather_sorted_multi(
+        tbl_local, slots_flat, r_off, bf16, pack_of(tbl_local, K)
+    )
+    occm_t = occ_t[:K] * mask_flat[None, :]
+
+    # rows arrive shard-local [0, R); globalize by source index so one
+    # segment space covers all D source shards' rows
+    grow = (r_row + jnp.arange(D, dtype=jnp.int32)[:, None] * R).reshape(-1)
+
+    def owner_reduce(partials):
+        mine = jax.lax.psum_scatter(
+            partials, DATA_AXIS, scatter_dimension=0, tiled=True
+        )  # [1, R(*nf), ch]
+        return jax.lax.psum(mine, TABLE_AXIS)[0]
+
+    if mode == "ffm":
+        from xflow_tpu.models.ffm import make_ffm_row_op
+        from xflow_tpu.ops.sorted_table import segment_sum_channels
+
+        k_lat = cfg.model.v_dim
+        fields_flat = wire_rows(a2a(fs_fields)).reshape(-1)
+        # FFM channel contract + exact-at-zeros hand VJP
+        # (models/ffm.py make_ffm_row_op): one segment-sum into the
+        # per-(row, field) space, owner_reduce row return like the
+        # segment MVM mode; the bwd all-gathers the [R, nf·(K+1)]
+        # row aggregates over 'data' — the same traffic class as
+        # the plain path's d_sums transpose
+        op = make_ffm_row_op(
+            lambda data, seg: owner_reduce(
+                segment_sum_channels(data, seg, D * R * nf).reshape(
+                    D, R * nf, K + 1
+                )
+            ).reshape(R, nf, K + 1),
+            lambda arr: jax.lax.all_gather(arr, DATA_AXIS, tiled=True),
+            nf, k_lat,
+            # the shard_map transpose hands each 'table' copy dl/T
+            # (make_ffm_row_op docstring) — restore before use
+            restore_dl=lambda dl: jax.lax.psum(dl, TABLE_AXIS),
+        )
+        return op(occ_t, mask_flat, fields_flat, grow)
+    if mode == "mvm_segment":
+        from xflow_tpu.ops.sorted_table import segment_sum_channels
+
+        r_fields = wire_rows(a2a(fs_fields))
+        seg = grow * nf + r_fields.reshape(-1)
+        # mask rides as an extra channel: its segment-sum is the
+        # per-(row, field) occurrence count => `present` (models/mvm.py)
+        stacked = jnp.concatenate([occm_t, mask_flat[None, :]], axis=0)
+        sums_t = segment_sum_channels(stacked, seg, D * R * nf)  # [D*R*nf, k+1]
+        sums = owner_reduce(sums_t.reshape(D, R * nf, K + 1))
+        sums = sums.reshape(R, nf, K + 1)
+        s, present = sums[..., :K], sums[..., K] > 0
+        factors = jnp.where(present[..., None], s + plus, 1.0)
+        return jnp.prod(factors, axis=1).sum(axis=-1)
+    if mode == "mvm_product":
+        from xflow_tpu.models.mvm import make_row_products
+
+        # log-space product channels are ADDITIVE over shards (sums
+        # of ln|v| / negative and zero counts), so the cross-shard
+        # reduction is the same rowsum + psum_scatter + psum as FM's;
+        # the op's bwd all-gathers the small [R, 4k] row aggregates
+        # over 'data' — the same traffic class as FM's backward
+        op = make_row_products(
+            lambda stacked, rows_: owner_reduce(
+                row_sums_sorted(stacked, rows_, D * R).reshape(D, R, -1)
+            ),
+            lambda arr: jax.lax.all_gather(arr, DATA_AXIS, tiled=True),
+            K,
+        )
+        return op(occ_t[:K] + plus, mask_flat, grow).sum(axis=1)
+    from xflow_tpu.models.fm import fm_logits_from_sums, stack_channels
+
+    stacked = stack_channels(occm_t, K)  # [ch, N]
+    rs = row_sums_sorted(stacked, grow, D * R)  # [D*R, ch]
+    sums = owner_reduce(rs.reshape(D, R, -1))
+    return fm_logits_from_sums(sums, K, cfg)
+
+
+def _mode_statics(cfg: Config, mesh: Mesh):
+    """(D, tname, K, nf, bf16, plus) shared by the train and eval
+    builders — the ONE place the logical row width lives:
+    MVM [k], FM [1+k], FFM [1+nf·k]."""
+    D, _, _ = _dims(cfg, mesh)
+    mvm = cfg.model.name == "mvm"
+    ffm = cfg.model.name == "ffm"
+    nf = cfg.model.num_fields
+    K = cfg.model.v_dim if mvm else (
+        1 + nf * cfg.model.v_dim if ffm else 1 + cfg.model.v_dim
+    )
+    return (
+        D, "v" if mvm else "wv", K, nf, cfg.data.sorted_bf16,
+        1.0 if cfg.model.mvm_plus_one else 0.0,
+    )
+
+
+def _batch_mode(cfg: Config, batch: dict) -> str:
+    if cfg.model.name == "mvm":
+        return "mvm_segment" if "fs_fields" in batch else "mvm_product"
+    return "ffm" if cfg.model.name == "ffm" else "fm"
+
+
+def make_fullshard_eval_step(cfg: Config, mesh: Mesh) -> Callable:
+    """Forward-only fullshard step: eval consumes the SAME host plan the
+    train step does (fs_* buffers, one all_to_all + owner_reduce)
+    instead of shipping the dead row-major [B, F] arrays (~24 MB/batch
+    at bench shapes — round-3 weak #5). Returns reference-clamped pctrs
+    [B] sharded over 'data'."""
+    from xflow_tpu.metrics import reference_pctr
+
+    validate_sorted_fullshard(cfg, mesh)
+    D, tname, K, nf, bf16, plus = _mode_statics(cfg, mesh)
+    fs_spec = P(DATA_AXIS, TABLE_AXIS, None, None)
+    jitted: dict = {}
+
+    def build(mode: str):
+        with_fields = mode in ("mvm_segment", "ffm")
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(
+                P((DATA_AXIS, TABLE_AXIS), None),
+                fs_spec, fs_spec, fs_spec, fs_spec, fs_spec,
+                P(DATA_AXIS, None),  # labels (row count only)
+            ),
+            out_specs=P(DATA_AXIS, None),
+            check_vma=False,
+        )
+        def sharded_pctr(tbl, fss, fsr, fsm, fso, fsf, labels):
+            sq = lambda x: x[0, 0]
+            logits = _local_logits(
+                mode, tbl, sq(fss), sq(fsr), sq(fsm), sq(fso), sq(fsf),
+                labels.shape[1], cfg, D, K, nf, bf16, plus,
+            )
+            return reference_pctr(logits)[None, :]
+
+        def eval_step(tables, batch: dict):
+            fsf = batch["fs_fields"] if with_fields else batch["fs_slots"]
+            return sharded_pctr(
+                tables[tname],
+                batch["fs_slots"], batch["fs_row"], batch["fs_mask"],
+                batch["fs_off"], fsf,
+                batch["labels"].reshape(D, -1),
+            ).reshape(-1)
+
+        keys = FS_KEYS + (("fs_fields",) if with_fields else ()) + ("labels",)
+        return eval_step, keys
+
+    def call(tables, batch: dict):
+        mode = _batch_mode(cfg, batch)
+        if mode not in jitted:
+            step, keys = build(mode)
+            jitted[mode] = (jax.jit(step), keys)
+        fn, keys = jitted[mode]
+        return fn(tables, {k: batch[k] for k in keys})
+
+    return call
+
+
 def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
     """FM/MVM train step with everything sharded over ('data','table').
 
@@ -273,95 +467,23 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
     ranks' collective sequences always agree.
     """
     validate_sorted_fullshard(cfg, mesh)
-    D, T, _ = _dims(cfg, mesh)
-    mvm = cfg.model.name == "mvm"
-    tname = "v" if mvm else "wv"
-    nf = cfg.model.num_fields
-    bf16 = cfg.data.sorted_bf16
-    plus = 1.0 if cfg.model.mvm_plus_one else 0.0
-    K = cfg.model.v_dim + (0 if mvm else 1)  # LOGICAL row width
+    D, tname, K, nf, bf16, plus = _mode_statics(cfg, mesh)
+
+    def local_logits(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off,
+                     fs_fields, R):
+        return _local_logits(
+            mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
+            R, cfg, D, K, nf, bf16, plus,
+        )
 
     def local_loss(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
                    labels, row_mask):
-        """Device (d, t) body. tbl_local [S/(D*T)/pack, pack*K]; fs_* are
-        MY source shard's buffers for column t, [D_dst, cap]; labels
-        [R]. Storage may be packed (ops/sorted_table.pack_table) —
-        detected from the shard's shape, slot indices stay logical."""
-        from xflow_tpu.ops.sorted_table import pack_of
-
-        R = labels.shape[0]
-
-        # 2. exchange: my buffer for dest d' -> device (d', t); receive
-        # every source's buffer for MY block. One collective, over 'data'.
-        def a2a(x):
-            return jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
-
-        from xflow_tpu.ops.sorted_table import wire_mask, wire_rows
-
-        r_slots = a2a(fs_slots)  # [D_src, cap]
-        # compacted wire dtypes (compact_plan_wire) ride through the
-        # all_to_all — less ICI traffic too — and upcast after
-        r_row = wire_rows(a2a(fs_row))
-        r_mask = wire_mask(a2a(fs_mask))
-        r_off = a2a(fs_off)  # [D_src, wpo+1]
-        slots_flat = r_slots.reshape(-1)
-        mask_flat = jax.lax.stop_gradient(r_mask.reshape(-1))
-
-        # 3. local windowed gather (+ shard-local scatter in the VJP)
-        occ_t = table_gather_sorted_multi(
-            tbl_local, slots_flat, r_off, bf16, pack_of(tbl_local, K)
+        """Device (d, t) body: the shared forward (`_local_logits`) plus
+        the loss reduction."""
+        logits = local_logits(
+            mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
+            labels.shape[0],
         )
-        occm_t = occ_t[:K] * mask_flat[None, :]
-
-        # rows arrive shard-local [0, R); globalize by source index so one
-        # segment space covers all D source shards' rows
-        grow = (r_row + jnp.arange(D, dtype=jnp.int32)[:, None] * R).reshape(-1)
-
-        # 4. return aggregated rows to their owners: block d' of the
-        # partial sums belongs to the devices with data-coordinate d'
-        def owner_reduce(partials):
-            mine = jax.lax.psum_scatter(
-                partials, DATA_AXIS, scatter_dimension=0, tiled=True
-            )  # [1, R(*nf), ch]
-            return jax.lax.psum(mine, TABLE_AXIS)[0]
-
-        if mode == "mvm_segment":
-            r_fields = wire_rows(a2a(fs_fields))
-            seg = grow * nf + r_fields.reshape(-1)
-            # mask rides as an extra channel: its segment-sum is the
-            # per-(row, field) occurrence count => `present` (models/mvm.py)
-            stacked = jnp.concatenate([occm_t, mask_flat[None, :]], axis=0)
-            sums_t = jax.vmap(
-                lambda r: jax.ops.segment_sum(r, seg, num_segments=D * R * nf)
-            )(stacked)  # [k+1, D*R*nf]
-            sums = owner_reduce(sums_t.reshape(K + 1, D, R * nf).transpose(1, 2, 0))
-            sums = sums.reshape(R, nf, K + 1)
-            s, present = sums[..., :K], sums[..., K] > 0
-            factors = jnp.where(present[..., None], s + plus, 1.0)
-            logits = jnp.prod(factors, axis=1).sum(axis=-1)
-        elif mode == "mvm_product":
-            from xflow_tpu.models.mvm import make_row_products
-
-            # log-space product channels are ADDITIVE over shards (sums
-            # of ln|v| / negative and zero counts), so the cross-shard
-            # reduction is the same rowsum + psum_scatter + psum as FM's;
-            # the op's bwd all-gathers the small [R, 4k] row aggregates
-            # over 'data' — the same traffic class as FM's backward
-            op = make_row_products(
-                lambda stacked, rows_: owner_reduce(
-                    row_sums_sorted(stacked, rows_, D * R).reshape(D, R, -1)
-                ),
-                lambda arr: jax.lax.all_gather(arr, DATA_AXIS, tiled=True),
-                K,
-            )
-            logits = op(occ_t[:K] + plus, mask_flat, grow).sum(axis=1)
-        else:
-            from xflow_tpu.models.fm import fm_logits_from_sums, stack_channels
-
-            stacked = stack_channels(occm_t, K)  # [ch, N]
-            rs = row_sums_sorted(stacked, grow, D * R)  # [D*R, ch]
-            sums = owner_reduce(rs.reshape(D, R, -1))
-            logits = fm_logits_from_sums(sums, K, cfg)
         per_row = binary_logloss_from_logits(logits, labels)
         loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
         rows_n = jax.lax.psum(row_mask.sum(), DATA_AXIS)
@@ -371,7 +493,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
 
     def build(mode: str):
         """One jitted step per row-side mode (its own collective program)."""
-        with_fields = mode == "mvm_segment"
+        with_fields = mode in ("mvm_segment", "ffm")
 
         @partial(
             jax.shard_map,
@@ -422,11 +544,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
     jitted: dict = {}
 
     def call(state: TrainState, batch: dict):
-        mode = (
-            ("mvm_segment" if "fs_fields" in batch else "mvm_product")
-            if mvm
-            else "fm"
-        )
+        mode = _batch_mode(cfg, batch)
         if mode not in jitted:
             step, bsh = build(mode)
             ssh = state_shardings(state, mesh)
