@@ -112,6 +112,10 @@ def cmd_gen_data(args) -> int:
     from xflow_tpu.data.synth import generate_shards, generate_shards_bulk
 
     if args.bulk:
+        if args.truth != "linear":
+            print("--bulk supports only the linear truth (the vectorized "
+                  "writer has no field-pair mode)", file=sys.stderr)
+            return 2
         paths, _ = generate_shards_bulk(
             args.out_prefix, args.shards, args.rows,
             num_fields=args.fields, ids_per_field=args.ids_per_field,
@@ -124,6 +128,7 @@ def cmd_gen_data(args) -> int:
         args.out_prefix, args.shards, args.rows,
         num_fields=args.fields, ids_per_field=args.ids_per_field, seed=args.seed,
         truth_seed=args.truth_seed, zipf_alpha=args.zipf_alpha,
+        truth=args.truth,
     )
     print("\n".join(paths))
     return 0
@@ -216,7 +221,8 @@ def main(argv=None) -> int:
     tr = sub.add_parser("train", help="train a model (LR/FM/MVM)")
     tr.add_argument("--train", required=True, help="train shard prefix (reads <prefix>-%%05d)")
     tr.add_argument("--test", default="", help="test shard prefix")
-    tr.add_argument("--model", default="lr", help="lr|fm|mvm or reference index 0|1|2")
+    tr.add_argument("--model", default="lr",
+                    help="lr|fm|mvm|ffm or reference index 0|1|2")
     tr.add_argument("--epochs", type=int, default=None)
     tr.add_argument("--batch-size", type=int, default=None)
     tr.add_argument("--optimizer", default=None, help="ftrl|sgd")
@@ -241,6 +247,10 @@ def main(argv=None) -> int:
                          "same value for train/test splits generated with different --seed")
     gd.add_argument("--zipf-alpha", type=float, default=0.0,
                     help="power-law feature skew (0 = uniform; ~1.1 ≈ CTR-like)")
+    gd.add_argument("--truth", default="linear",
+                    help="planted concept: linear | ffm (field-pair "
+                         "interactions with non-separable signs — the "
+                         "field-aware-model learnability gate)")
     gd.add_argument("--bulk", action="store_true",
                     help="chunked vectorized writer for realistic-scale datasets "
                          "(~30x faster; different RNG stream than the default)")

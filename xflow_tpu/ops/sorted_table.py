@@ -713,7 +713,8 @@ def _gather_kernel_multi(off_ref, slots_ref, table_ref, out_ref, slc, old, sem_s
 PIPE_NB = 6  # gather chunk-chain pipeline depth (buffers); the chain is
 # DMA-latency bound (_gather_span), so deeper prefetch hides more of the
 # per-chunk wait — 6 measured best vs 3 on v5e at bench shapes; VMEM cost
-# is NB × (K8+1) × CHUNK × 4 B ≈ 70 KB, noise
+# is NB × (K8+1) × CHUNK × 4 B: ~110 KB at K8=8, ~210 KB for the fused FM
+# row (K8=16), ~1 MB for FFM's K8=80 — all small next to the table block
 
 
 def _gather_pallas(table, sorted_slots, win_off, bf16=False, pack=1):

@@ -199,22 +199,12 @@ class Trainer:
 
                 self.train_step = _dispatch
             elif self._mesh_engine == "replicated":
-                if (
-                    cfg.model.name == "mvm"
-                    and cfg.model.mvm_exclusive == "auto"
-                    and jax.process_count() > 1
-                ):
-                    # only the fullshard engine has the per-batch flag
-                    # allgather that makes data-dependent routing
-                    # rank-symmetric; here a divergent per-rank choice
-                    # would desync the collective programs, so demand an
-                    # explicit mode up front
-                    raise ValueError(
-                        "multi-process replicated engine + model.name=mvm "
-                        "needs an explicit model.mvm_exclusive=on or off "
-                        "(auto's per-batch routing is only coordinated on "
-                        "the fullshard engine)"
-                    )
+                # multi-process `mvm_exclusive=auto` here behaves like
+                # `on`: clean one-feature-per-field data takes the
+                # product path; a duplicate-field batch raises
+                # (resolve_mvm_product — only the fullshard engine has
+                # the per-batch flag allgather that makes data-dependent
+                # routing rank-symmetric)
                 from xflow_tpu.parallel.sorted_sharded import (
                     make_sorted_sharded_train_step,
                     shard_sorted_state,
