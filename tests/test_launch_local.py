@@ -220,6 +220,49 @@ def test_launch_local_two_process_sorted_engine(tmp_path, engine):
     np.testing.assert_allclose(d2["opt/wv/n"], d1["opt/wv/n"], rtol=1e-5, atol=1e-6)
 
 
+def test_launch_local_two_process_fullshard_ffm(tmp_path):
+    """Multi-process FFM on the fullshard engine (the widest-row model:
+    the segment-mode a2a ships [1+nf*k]-channel buffers across the
+    process boundary): final tables match a single-process run on the
+    batch-composed data."""
+    B, rows = 32, 96
+    ffm_args = [
+        "--model", "ffm", "--epochs", "2", "--log2-slots", "13",
+        "--set", "model.num_fields=4", "--set", "model.v_dim=3",
+        "--set", "data.max_nnz=8",
+        "--set", "train.pred_dump=false", "--set", "data.sorted_layout=on",
+        "--set", "data.sorted_mesh=fullshard",
+    ]
+    generate_shards(str(tmp_path / "train"), 2, rows, num_fields=4, ids_per_field=50)
+    r2 = run_cli(
+        ["launch-local", "--num-processes", "2", "--",
+         "--train", str(tmp_path / "train"), "--batch-size", str(B),
+         "--checkpoint-dir", str(tmp_path / "ckpt2p"), *ffm_args],
+        tmp_path,
+    )
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    s2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert s2["steps"] == 2 * (rows // B)
+
+    _interleave_shards(
+        [tmp_path / "train-00000", tmp_path / "train-00001"], B, tmp_path / "comb-00000"
+    )
+    r1 = run_cli(
+        ["train", "--train", str(tmp_path / "comb"), "--batch-size", str(2 * B),
+         "--checkpoint-dir", str(tmp_path / "ckpt1p"), "--no-mesh", *ffm_args],
+        tmp_path,
+    )
+    assert r1.returncode == 0, r1.stderr
+    s1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    assert s1["steps"] == s2["steps"]
+    d2 = np.load(tmp_path / "ckpt2p" / f"step_{s2['steps']}" / "state.npz")
+    d1 = np.load(tmp_path / "ckpt1p" / f"step_{s1['steps']}" / "state.npz")
+    np.testing.assert_allclose(
+        d2["tables/wv"], d1["tables/wv"], rtol=1e-4, atol=1e-6,
+        err_msg="2-process fullshard ffm != single-process on composed data",
+    )
+
+
 def test_launch_local_two_process_mvm_auto_dup_coordination(tmp_path):
     """ADVICE r3: multi-process MVM `mvm_exclusive=auto` must not raise
     (or desync) on duplicate fields. Only rank 0's FIRST batch has a

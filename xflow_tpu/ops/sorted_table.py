@@ -643,9 +643,13 @@ def _gather_span(slots_ref, out_ref, table_ref, slc, old, sem_s, sem_d, sem_o,
         co.wait()
         in_win = (rel >= 0) & (rel < WINDOW)  # [1, C]
         # blend: positions whose slot is outside this window belong to a
-        # neighboring window's (or buffer's) chunks — keep what is there
-        pad = jnp.zeros((old.shape[1] - K, CHUNK), jnp.float32)
-        old[sel] = jnp.where(in_win, jnp.concatenate([occ, pad], axis=0), old[sel])
+        # neighboring window's (or buffer's) chunks — keep what is there.
+        # No concat when K is already sublane-aligned: Mosaic rejects the
+        # zero-row pad array (K=96/128/... would fail to compile)
+        if old.shape[1] > K:
+            pad = jnp.zeros((old.shape[1] - K, CHUNK), jnp.float32)
+            occ = jnp.concatenate([occ, pad], axis=0)
+        old[sel] = jnp.where(in_win, occ, old[sel])
         out_copy(c).start()
 
         @pl.when(c + NB - 1 < n_chunks)

@@ -164,6 +164,39 @@ def check_kernel_parity(
         unpack_table(got_ps, k), got_s, floor=1e-2
     )
 
+    # --- sublane-ALIGNED row width (K8 == K): the kernels' pad-to-K8
+    # blend has no pad rows here, a branch Mosaic only sees at aligned
+    # widths (a zero-row pad array failed to compile for every
+    # 8-multiple K until round 4 — FFM/MVM widths like 96 or 128 hit it)
+    k_al = 16
+    tbl_al = jnp.asarray(
+        pack_table(rng.standard_normal((S, k_al)).astype(np.float32))
+    )
+    got_al = np.asarray(
+        jax.jit(lambda t, s, w: table_gather_sorted(t, s, w, False, 8))(
+            tbl_al, ss, wo
+        )
+    )
+    want_al = np.asarray(jax.jit(lambda t, s: _gather_xla(t, s, None, 8))(tbl_al, ss))
+    checks["gather_aligned_k"] = _rel_err(got_al, want_al)
+
+    def scat_al(t, s, w, d):
+        _, vjp = jax.vjp(lambda tt: table_gather_sorted(tt, s, w, False, 8), t)
+        return vjp(d)[0]
+
+    d_al = (rng.standard_normal(got_al.shape).astype(np.float32)
+            * np.asarray(plan.sorted_mask)[None, :])
+    got_als = np.asarray(jax.jit(scat_al)(tbl_al, ss, wo, jnp.asarray(d_al)))
+    want_als = np.asarray(
+        jax.jit(
+            lambda d, s: jax.ops.segment_sum(d.T, s, num_segments=S)
+        )(jnp.asarray(d_al[:k_al]), ss)
+    )
+    # compare in the packed layout the kernel writes
+    checks["scatter_aligned_k"] = _rel_err(
+        unpack_table(got_als, k_al), want_als, floor=1e-2
+    )
+
     # --- row-sum kernel (the FM forward's occurrence->row reduction)
     ch = 24
     vals_t = (rng.standard_normal((ch, Np)).astype(np.float32)
@@ -191,6 +224,8 @@ def check_kernel_parity(
         "scatter_multi_exact": 1e-4,
         "gather_packed": 0.0,
         "scatter_packed": 1e-4,
+        "gather_aligned_k": 0.0,
+        "scatter_aligned_k": 1e-4,
         "rowsum": 1e-4,
     }
     ok = all(checks[name] <= tol[name] for name in tol)
