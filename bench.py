@@ -169,7 +169,8 @@ def main() -> int:
     zipf_slots_cache = {}
 
     def bench_model(name: str, dists, dup_fields: bool = False,
-                    log2_slots: int = 0, batch: int = 0, nnz: int = 0) -> dict:
+                    log2_slots: int = 0, batch: int = 0, nnz: int = 0,
+                    sorted_bf16: bool = None) -> dict:
         """Compile the model's K-step program ONCE, then time each slot
         distribution on it (shapes identical → no recompile).
 
@@ -191,13 +192,15 @@ def main() -> int:
         """
         log2_slots = log2_slots or args.log2_slots
         B_, F_ = batch or args.batch, nnz or args.nnz
+        if sorted_bf16 is None:
+            sorted_bf16 = args.sorted_bf16
         overrides = {
             "model.name": name,
             "data.log2_slots": log2_slots,
             "data.max_nnz": F_,
             "data.batch_size": B_,
             "data.sorted_sub_batches": args.sub_batches,
-            "data.sorted_bf16": args.sorted_bf16,
+            "data.sorted_bf16": sorted_bf16,
         }
         if name == "mvm":
             if dup_fields:
@@ -409,6 +412,15 @@ def main() -> int:
                 record[f"{name}_s24_vs_baseline"] = round(
                     r24["uniform"] / PER_CHIP_TARGET, 3
                 )
+        if not args.smoke and not args.sorted_bf16:
+            # bf16 fast-mode rider (cfg.data.sorted_bf16, docs/PERF.md
+            # "Precision note"): the one-pass MXU read the exact default
+            # deliberately forgoes — recorded so the trade stays visible
+            b16 = bench_model("fm", ("uniform",), sorted_bf16=True)
+            record["fm_bf16_examples_per_sec"] = round(b16["uniform"], 1)
+            record["fm_bf16_vs_baseline"] = round(
+                b16["uniform"] / PER_CHIP_TARGET, 3
+            )
         if not args.smoke:
             # end-to-end rider (round-3 verdict #5): disk → C++ parser →
             # plan → device, the number `xflow train` actually delivers;

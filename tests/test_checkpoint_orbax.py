@@ -86,6 +86,83 @@ def test_orbax_packed_layout_migration(tmp_path):
     )
 
 
+def test_fused_layout_bridge_both_formats(tmp_path):
+    """A checkpoint written with one model.fm_fused setting restores
+    into the other (round-3 weak #6's last unclosed case): the fused
+    wv splits into w/v columns (and FTRL n/z likewise), the two-table
+    layout merges — npz AND orbax, with packed storage in play."""
+    import jax.numpy as jnp
+
+    from xflow_tpu.train.checkpoint import restore, save
+
+    base = {"data.log2_slots": 12}
+    cfg_fused = override(Config(), **base)
+    cfg_two = override(Config(), **{**base, "model.fm_fused": False})
+    model, opt = get_model("fm"), get_optimizer("ftrl")
+    k = cfg_fused.model.v_dim
+    S = 1 << 12
+
+    state_f = init_state(model, opt, override(cfg_fused, **{}))
+    state_f = state_f._replace(
+        tables={"wv": state_f.tables["wv"] + 0.125},
+        opt_state={"wv": {kk: vv + 1.0 for kk, vv in state_f.opt_state["wv"].items()}},
+        step=jnp.asarray(5, jnp.int32),
+    )
+    from xflow_tpu.ops.sorted_table import unpack_table
+
+    wv_logical = np.asarray(unpack_table(state_f.tables["wv"], 1 + k))
+
+    # fused -> two-table, npz
+    save(str(tmp_path / "npz"), state_f, {"wv": 1 + k})
+    like_two = init_state(model, opt, override(Config(), **{**base, "model.fm_fused": False}))
+    got = restore(str(tmp_path / "npz"), like_two)
+    np.testing.assert_allclose(np.asarray(got.tables["w"]), wv_logical[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(unpack_table(got.tables["v"], k)), wv_logical[:, 1:]
+    )
+    n_logical = np.asarray(unpack_table(state_f.opt_state["wv"]["n"], 1 + k))
+    np.testing.assert_allclose(np.asarray(got.opt_state["w"]["n"]), n_logical[:, 0])
+
+    # two-table -> fused, npz (round-trip back)
+    save(str(tmp_path / "npz2"), got, {"v": k})
+    like_fused = init_state(model, opt, cfg_fused)
+    back = restore(str(tmp_path / "npz2"), like_fused)
+    np.testing.assert_allclose(
+        np.asarray(back.tables["wv"]), np.asarray(state_f.tables["wv"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(back.opt_state["wv"]["z"]), np.asarray(state_f.opt_state["wv"]["z"])
+    )
+
+    # fused -> two-table, ORBAX (stores the PACKED native layout; the
+    # bridge's size-derived reshape is the free unpack)
+    save_orbax(str(tmp_path / "ob"), state_f)
+    got_ob = restore_orbax(str(tmp_path / "ob"), init_state(model, opt, cfg_two))
+    np.testing.assert_allclose(np.asarray(got_ob.tables["w"]), wv_logical[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(unpack_table(got_ob.tables["v"], k)), wv_logical[:, 1:]
+    )
+    assert int(got_ob.step) == 5
+
+
+def test_fused_bridge_does_not_cross_models(tmp_path):
+    """The fused<->two-table bridge must NOT fire for other models: a
+    fused-FM checkpoint restored into LR (w only) or MVM (v only) is a
+    cross-model mistake and stays a loud error, never a silent
+    column-slice restore."""
+    import jax.numpy as jnp
+
+    from xflow_tpu.train.checkpoint import restore, save
+
+    cfg = override(Config(), **{"data.log2_slots": 12})
+    fm_state = init_state(get_model("fm"), get_optimizer("ftrl"), cfg)
+    save(str(tmp_path), fm_state, {"wv": 1 + cfg.model.v_dim})
+    for other in ("lr", "mvm"):
+        like = init_state(get_model(other), get_optimizer("ftrl"), cfg)
+        with pytest.raises(RuntimeError, match="different model"):
+            restore(str(tmp_path), like)
+
+
 def test_trainer_orbax_resume(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     generate_shards(str(tmp_path / "train"), 1, 600, num_fields=5, ids_per_field=30, seed=0)

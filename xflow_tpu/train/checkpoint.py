@@ -103,6 +103,47 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def _fused_alias(lookup, tbl: str, like: TrainState):
+    """Derive table (or per-table opt-state) array `tbl` from the OTHER
+    FM layout when the checkpoint was written with a different
+    `model.fm_fused` setting: stored fused ``wv [S, 1+k]`` splits into
+    ``w = wv[:, 0]`` / ``v = wv[:, 1:]``; stored two-table merges by
+    concatenation. FTRL's n/z split/merge identically (the update is
+    elementwise per column). `lookup(name)` returns the stored array
+    for the SAME group/sub-key (tables, opt n, opt z, ...) or None.
+    Shapes are size-derived and normalized to the LOGICAL layout; the
+    caller's reshape migration re-packs as needed. Returns None when
+    the bridge doesn't apply."""
+    if tbl in ("w", "v"):
+        wv = lookup("wv")
+        # gate on the template really being the two-table FM layout —
+        # restoring a fused checkpoint into LR (w only) or MVM (v only)
+        # must stay a loud error, not a silent cross-model restore
+        if wv is None or "w" not in like.tables or "v" not in like.tables:
+            return None
+        S = like.tables["w"].size
+        k_like = like.tables["v"].size // S
+        wv = np.asarray(wv)
+        if wv.size != S * (1 + k_like):
+            return None  # dims differ: not a pure layout change
+        wv = wv.reshape(S, 1 + k_like)
+        return np.ascontiguousarray(wv[:, 0] if tbl == "w" else wv[:, 1:])
+    if tbl == "wv":
+        w, v = lookup("w"), lookup("v")
+        if w is None or v is None:
+            return None
+        w = np.asarray(w).reshape(-1, 1)
+        S = w.shape[0]
+        if (
+            np.asarray(v).size % S != 0
+            or like.tables["wv"].size != S + np.asarray(v).size
+        ):
+            return None  # dims differ: not a pure layout change
+        v = np.asarray(v).reshape(S, -1)
+        return np.concatenate([w, v], axis=1)
+    return None
+
+
 def _put_migrated(label: str, arr, template, stored_tables, source: str):
     """Place one stored array into a template leaf, migrating layout.
 
@@ -116,10 +157,11 @@ def _put_migrated(label: str, arr, template, stored_tables, source: str):
     if arr is None:
         raise RuntimeError(
             f"checkpoint {source!r} has no array {label!r} (stored tables: "
-            f"{list(stored_tables)}). If this is an FM checkpoint written "
-            "with the two-table layout, set model.fm_fused=false to restore "
-            "it (or re-train; the fused [S,1+k] layout is the current "
-            "default)."
+            f"{list(stored_tables)}), and no layout bridge applies — the "
+            "fused<->two-table FM bridge (_fused_alias) and the "
+            "packed<->logical reshape both handle their cases "
+            "automatically, so this checkpoint belongs to a different "
+            "model/config."
         )
     arr = np.asarray(arr)
     if arr.shape != template.shape:
@@ -166,6 +208,19 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
 
     def put(name: str, template):
         arr = data[name] if name in data else None
+        if arr is None:
+            # fm_fused layout bridge: the key path keeps its group/sub
+            # ("tables/w" <- "tables/wv"; "opt/w/n" <- "opt/wv/n")
+            group, rest = name.split("/", 1)
+            parts = rest.split("/")
+            sub = "/" + parts[1] if len(parts) > 1 else ""
+            arr = _fused_alias(
+                lambda t: data[f"{group}/{t}{sub}"]
+                if f"{group}/{t}{sub}" in data
+                else None,
+                parts[0],
+                like,
+            )
         return _put_migrated(name, arr, template, stored_tables, path)
 
     tables = {n: put(f"tables/{n}", t) for n, t in like.tables.items()}
@@ -277,14 +332,16 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
                 restored = ckptr.restore(path, abstract)
         except Exception as e:
             if stored_shapes is None and "wv" in like.tables:
-                # metadata was unreadable, so migration detection could not
-                # run: if this is a pre-fused (two-table) FM checkpoint,
-                # say how to bridge instead of orbax's raw tree-mismatch
+                # metadata was unreadable, so migration detection (and the
+                # automatic fused<->two-table bridge it would route to)
+                # could not run: say how to bridge manually instead of
+                # surfacing orbax's raw tree-mismatch
                 raise RuntimeError(
-                    f"orbax restore of {path!r} failed ({e}). If this is an "
-                    "FM checkpoint written with the two-table layout, set "
-                    "model.fm_fused=false to restore it — the fused [S,1+k] "
-                    "layout is the current default."
+                    f"orbax restore of {path!r} failed ({e}), and this "
+                    "checkpoint's metadata is unreadable so the automatic "
+                    "layout bridge could not engage. If it is an FM "
+                    "checkpoint written with the two-table layout, set "
+                    "model.fm_fused=false to restore it."
                 ) from e
             raise
         return TrainState(**restored)
@@ -296,16 +353,33 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
         stored = ckptr.restore(path)  # host numpy, stored shapes
     stored_tables = sorted(stored.get("tables", {}))
 
-    def put(label: str, arr, template):
+    def put(label: str, arr, lookup, tbl, template):
+        if arr is None:
+            # fm_fused layout bridge (same rule as the npz path); stored
+            # arrays may be packed — _fused_alias's size-derived reshape
+            # is the free unpack
+            arr = _fused_alias(lookup, tbl, like)
         return _put_migrated(label, arr, template, stored_tables, path)
 
     tables = {
-        n: put(f"tables/{n}", stored.get("tables", {}).get(n), t)
+        n: put(
+            f"tables/{n}",
+            stored.get("tables", {}).get(n),
+            lambda t: stored.get("tables", {}).get(t),
+            n,
+            t,
+        )
         for n, t in like.tables.items()
     }
     opt_state = {
         n: {
-            k: put(f"opt_state/{n}/{k}", stored.get("opt_state", {}).get(n, {}).get(k), v)
+            k: put(
+                f"opt_state/{n}/{k}",
+                stored.get("opt_state", {}).get(n, {}).get(k),
+                lambda t, k=k: stored.get("opt_state", {}).get(t, {}).get(k),
+                n,
+                v,
+            )
             for k, v in st.items()
         }
         for n, st in like.opt_state.items()
