@@ -34,11 +34,22 @@ through ⟨v_{i,c}, v_{j,c}⟩, which IS the textbook term since f_j = c.
 and the diagonal counts same-field pairs twice plus the self terms;
 halving and subtracting the selves leaves exactly Σ_{i<j}.)
 
-Memory note: S is [B, nf, nf, k] — at B = 64k, nf = 18, k = 4 that is
-~332 MB, so large-batch training runs the sorted path, which maps over
-row-contiguous sub-batches (`resolve_sub_batches` sizes NS for FFM's
-row state). The row-major path serves eval, small batches, and the
-GSPMD fallback.
+Memory note: S is [B, nf, nf, k] — ~332 MB at B = 64k, nf = 18, k = 4
+(transient; fine on a 16 GB chip, and the fullshard mesh path never
+builds it).
+
+Path choice (measured, docs/PERF.md round-4 #5): on ONE device the
+row-major MXU path is FASTER than the sorted segment engine at the
+practical shape (193k vs 123k ex/s), so `sorted_layout=auto` keeps FFM
+row-major; the segment mode is the fullshard MESH engine's row side,
+where the no-replication layout requires it. Known limitation of the
+FORCED single-device sorted path (`sorted_layout=on`): at very wide
+fused rows with large batches (observed at nf·k = 128, B = 64k,
+2^22 slots) XLA's TPU compiler crashes building the fused program —
+the windowed kernels and the segment row side each compile fine in
+isolation at that exact shape, so this is a compiler-scale issue, not
+a kernel one. The default (`auto`) path and the practical bench shape
+(nf·k = 72) are unaffected.
 """
 
 from __future__ import annotations
