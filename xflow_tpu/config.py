@@ -54,6 +54,18 @@ class OptimConfig:
     sgd: SGDConfig = field(default_factory=SGDConfig)
     v_init_scale: float = 1e-2
     v_init_sgd: float = 1e-3
+    # fused scatter+FTRL (ops/sorted_table.scatter_ftrl_sorted): the
+    # single-device sorted FM step applies the optimizer INSIDE the
+    # windowed scatter's block write (in-place state aliasing), so the
+    # [S/8, 8K] table gradient never materializes in HBM. Measured
+    # throughput-NEUTRAL vs the two-pass form (XLA already fuses that
+    # chain; docs/PERF.md lever 5b) — the win is one table-sized
+    # transient off peak HBM (738 MB at 2^24 FM). "auto" (default)
+    # fuses when eligible (ftrl + fused FM + flat sorted plan, single
+    # device); "off" keeps the two-pass form. Identical math either
+    # way (equality-tested; the update runs on each window's COMPLETE
+    # gradient block; on-device scatter_ftrl_* parity checks).
+    fused_scatter: str = "auto"
 
 
 @dataclass(frozen=True)

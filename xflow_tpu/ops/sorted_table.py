@@ -976,6 +976,101 @@ def _scatter_pallas_multi(d_occ_t, sorted_slots, loc_off, num_slots, k, cap,
     )(loc_off, sorted_slots.reshape(1, n), d_occ_t)
 
 
+def _scatter_ftrl_kernel(off_ref, slots_ref, d_ref, w_ref, n_ref, z_ref,
+                         w_out, n_out, z_out, slc, dch, sem_s, sem_d,
+                         *, bf16, pack, alpha, beta, lambda1, lambda2):
+    """Fused windowed scatter-add + FTRL-proximal window update: grid
+    step t accumulates window t's complete gradient block (every chunk
+    of its span — the block's gradient is FINAL at the write point, so
+    applying the optimizer here is exact) and writes the UPDATED
+    (w, n, z) blocks instead of the gradient. The gradient never
+    exists in HBM, and the separate dense optimizer sweep — O(S) per
+    step regardless of batch (docs/PERF.md lever 5b) — disappears into
+    this already-streaming pass. FTRL math is optim/ftrl._update_one
+    verbatim (incl. the lazy-init parity guard)."""
+    from jax.experimental import pallas as pl
+
+    from xflow_tpu.optim.ftrl import _update_one
+
+    t = pl.program_id(0)
+    K8 = d_ref.shape[0]
+    K = w_out.shape[1] // pack
+    rows = pack * K if pack > 1 else K8
+    acc_t = jnp.zeros((rows, WINDOW // pack), jnp.float32)
+    acc_t = _scatter_span(
+        slots_ref, d_ref, slc, dch, sem_s, sem_d,
+        t * WINDOW, off_ref[t], off_ref[t + 1], acc_t, bf16, pack, K,
+    )
+    g = (acc_t if pack > 1 else acc_t[0:K, :]).T  # [W/pack, pack*K]
+    w_new, n_new, z_new = _update_one(
+        w_ref[:, :], n_ref[:, :], z_ref[:, :], g, alpha, beta, lambda1, lambda2
+    )
+    w_out[:, :] = w_new
+    n_out[:, :] = n_new
+    z_out[:, :] = z_new
+
+
+def _scatter_ftrl_pallas(d_occ_t, sorted_slots, win_off, w, n, z, k, hp,
+                         bf16=False, pack=1):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    K8, n_occ = d_occ_t.shape
+    num_slots = w.shape[0] * pack
+    n_win = num_slots // WINDOW
+    state_block = pl.BlockSpec((WINDOW // pack, pack * k), lambda t, off: (t, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_win,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # slots [1, Np]
+            pl.BlockSpec(memory_space=pl.ANY),  # d [K8, Np]
+            state_block, state_block, state_block,  # w, n, z windows
+        ],
+        out_specs=(state_block, state_block, state_block),
+        scratch_shapes=[
+            pltpu.VMEM((PIPE_NB, 1, CHUNK), jnp.int32),
+            pltpu.VMEM((PIPE_NB, K8, CHUNK), jnp.float32),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+            pltpu.SemaphoreType.DMA((PIPE_NB,)),
+        ],
+    )
+    shape = jax.ShapeDtypeStruct((num_slots // pack, pack * k), jnp.float32)
+    return pl.pallas_call(
+        partial(
+            _scatter_ftrl_kernel, bf16=bf16, pack=pack, alpha=hp.alpha,
+            beta=hp.beta, lambda1=hp.lambda1, lambda2=hp.lambda2,
+        ),
+        grid_spec=grid_spec,
+        out_shape=(shape, shape, shape),
+        # update the state in place. Alias indices count ALL flattened
+        # call operands INCLUDING the scalar-prefetch array: 0=win_off,
+        # 1=slots, 2=d, 3=w, 4=n, 5=z -> outputs 0..2 (verified: a
+        # {2: 0} mapping is rejected with d's shape in the error)
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+    )(win_off, sorted_slots.reshape(1, n_occ), d_occ_t, w, n, z)
+
+
+def scatter_ftrl_sorted(d_occ_t, sorted_slots, win_off, w, n, z, k: int, hp,
+                        bf16=False, pack=1):
+    """Windowed scatter-add of the occurrence cotangent + FTRL update in
+    ONE table pass: returns (w', n', z'). `hp` carries
+    (alpha, beta, lambda1, lambda2) — cfg.optim.ftrl. Semantically
+    identical to `table_gather_sorted`'s VJP followed by
+    optim/ftrl._update_one; the fusion removes the HBM-materialized
+    gradient and the separate dense optimizer sweep (the CPU/XLA
+    fallback composes exactly those pieces, so tests equate the two)."""
+    if _on_tpu():
+        return _scatter_ftrl_pallas(
+            d_occ_t, sorted_slots, win_off, w, n, z, k, hp, bf16, pack
+        )
+    from xflow_tpu.optim.ftrl import _update_one
+
+    num_slots = w.shape[0] * pack
+    g = _scatter_xla(d_occ_t, sorted_slots, win_off, num_slots, k, pack)
+    return _update_one(w, n, z, g, hp.alpha, hp.beta, hp.lambda1, hp.lambda2)
+
+
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 

@@ -39,7 +39,7 @@ def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
 def make_sharded_train_step(
     model: Model, optimizer: Optimizer, cfg: Config, mesh: Mesh
 ) -> Callable:
-    step = make_train_step(model, optimizer, cfg, jit=False)
+    step = make_train_step(model, optimizer, cfg, jit=False, allow_fused=False)
     # state shardings depend only on pytree structure; build from a spec of
     # the real state at first call via jit's lazy specialization
     bsh = batch_sharding(mesh)

@@ -197,6 +197,41 @@ def check_kernel_parity(
         unpack_table(got_als, k_al), want_als, floor=1e-2
     )
 
+    # --- fused scatter+FTRL (optim.fused_scatter): the Pallas window
+    # pass that applies the optimizer at the gradient block's write
+    # point must match the two-pass composition (XLA scatter + dense
+    # _update_one) it replaces — w through the soft-threshold, n, z
+    from xflow_tpu.config import FTRLConfig
+    from xflow_tpu.ops.sorted_table import _scatter_xla, scatter_ftrl_sorted
+    from xflow_tpu.optim.ftrl import _update_one
+
+    hp = FTRLConfig()
+    w0 = pack_table(rng.standard_normal((S, k)).astype(np.float32) * 0.01)
+    n0 = pack_table(np.abs(rng.standard_normal((S, k))).astype(np.float32) * 0.1)
+    z0 = pack_table(rng.standard_normal((S, k)).astype(np.float32) * 1e-4)
+    d_f = (rng.standard_normal((_k8(k), Np)).astype(np.float32)
+           * np.asarray(plan.sorted_mask)[None, :])
+    # the DISPATCHING wrapper: Pallas on TPU, the two-pass composition
+    # elsewhere — so this gate keeps running (trivially) off-TPU, per
+    # the module contract
+    got_f = jax.jit(
+        lambda d, s, w_, n_, z_: scatter_ftrl_sorted(
+            d, s, wo, w_, n_, z_, k, hp, False, 8
+        )
+    )(jnp.asarray(d_f), ss, jnp.asarray(w0), jnp.asarray(n0), jnp.asarray(z0))
+    g_ref = jax.jit(
+        lambda d, s: _scatter_xla(d, s, None, S, k, 8)
+    )(jnp.asarray(d_f), ss)
+    want_f = jax.jit(
+        lambda w_, n_, z_, g: _update_one(
+            w_, n_, z_, g, hp.alpha, hp.beta, hp.lambda1, hp.lambda2
+        )
+    )(jnp.asarray(w0), jnp.asarray(n0), jnp.asarray(z0), g_ref)
+    for i, name in ((0, "scatter_ftrl_w"), (1, "scatter_ftrl_n"), (2, "scatter_ftrl_z")):
+        checks[name] = _rel_err(
+            np.asarray(got_f[i]), np.asarray(want_f[i]), floor=1e-4
+        )
+
     # --- row-sum kernel (the FM forward's occurrence->row reduction)
     ch = 24
     vals_t = (rng.standard_normal((ch, Np)).astype(np.float32)
@@ -226,6 +261,11 @@ def check_kernel_parity(
         "scatter_packed": 1e-4,
         "gather_aligned_k": 0.0,
         "scatter_aligned_k": 1e-4,
+        # gradient reorder noise (scatter class) flows through FTRL's
+        # sqrt/divide; same tolerance class as the plain scatters
+        "scatter_ftrl_w": 1e-3,
+        "scatter_ftrl_n": 1e-3,
+        "scatter_ftrl_z": 1e-3,
         "rowsum": 1e-4,
     }
     ok = all(checks[name] <= tol[name] for name in tol)

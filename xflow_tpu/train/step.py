@@ -45,17 +45,113 @@ def batch_to_arrays(batch) -> dict:
     }
 
 
+def masked_mean_logloss(logits, labels, row_mask):
+    """Mean BCE over REAL rows (the reference divides by its sub-batch
+    line count, `lr_worker.cc:116-118`) — the one loss reduction, shared
+    by the autodiff and fused step forms so they cannot drift."""
+    per_row = binary_logloss_from_logits(logits, labels)
+    return (per_row * row_mask).sum() / jnp.maximum(row_mask.sum(), 1.0)
+
+
 def loss_fn(tables, batch, model: Model, cfg: Config):
     logits = model.forward(tables, batch, cfg)
-    per_row = binary_logloss_from_logits(logits, batch["labels"])
-    denom = jnp.maximum(batch["row_mask"].sum(), 1.0)
-    return (per_row * batch["row_mask"]).sum() / denom
+    return masked_mean_logloss(logits, batch["labels"], batch["row_mask"])
 
 
-def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool = True) -> Callable:
-    """Returns train_step(state, batch_arrays) -> (state, metrics)."""
+def _fused_scatter_eligible(cfg: Config, allow_fused: bool) -> bool:
+    """Fused scatter+FTRL (cfg.optim.fused_scatter, ops/sorted_table
+    .scatter_ftrl_sorted) applies to the single-device sorted fused-FM
+    step with FTRL — the one-table case where the step's whole table
+    gradient comes from a single windowed scatter. `allow_fused` is the
+    caller's single-device assertion: the sharded builders pass False
+    (an in-place window kernel over a sharded table is not this op's
+    contract), and `on` there is a config error, not a silent downgrade.
+    """
+    if cfg.optim.fused_scatter == "off":
+        return False
+    if cfg.optim.fused_scatter not in ("auto", "on"):
+        raise ValueError(
+            f"optim.fused_scatter={cfg.optim.fused_scatter!r}: expected auto|on|off"
+        )
+    ok = (
+        allow_fused
+        and cfg.optim.name == "ftrl"
+        and cfg.model.name == "fm"
+        and cfg.model.fm_fused
+    )
+    if cfg.optim.fused_scatter == "on" and not ok:
+        raise ValueError(
+            "optim.fused_scatter=on requires the single-device step with "
+            "optim.name=ftrl, model.name=fm, model.fm_fused=true; got "
+            f"optim={cfg.optim.name} model={cfg.model.name} "
+            f"fm_fused={cfg.model.fm_fused} single_device={allow_fused}"
+        )
+    return ok
+
+
+def _fused_fm_step(state: TrainState, batch: dict, cfg: Config):
+    """Sorted fused-FM train step with the optimizer applied inside the
+    scatter's window write: gather → row-side vjp → ONE
+    scatter_ftrl_sorted pass. Bit-equal to value_and_grad + ftrl.apply
+    (same kernels, same elementwise math on each window's complete
+    gradient block); the difference is that the [S, 1+k] gradient never
+    exists in HBM and the dense optimizer sweep is gone."""
+    from xflow_tpu.models.fm import _row_side_sorted
+    from xflow_tpu.ops.sorted_table import pack_of, scatter_ftrl_sorted, table_gather_sorted
+
+    wv = state.tables["wv"]
+    K = 1 + cfg.model.v_dim
+    pack = pack_of(wv, K)
+    occ_t = table_gather_sorted(
+        wv, batch["sorted_slots"], batch["win_off"], cfg.data.sorted_bf16, pack
+    )
+
+    def row_loss(occ):
+        # the row side and the loss reduction are the SAME functions the
+        # two-pass form uses (fm._row_side_sorted via sorted_gather_map;
+        # masked_mean_logloss via loss_fn) — only the gather/scatter seam
+        # is split here so the table cotangent feeds the fused kernel
+        logits = _row_side_sorted(
+            occ, batch["sorted_row"], batch["sorted_mask"],
+            batch["labels"].shape[0], cfg,
+        )
+        return masked_mean_logloss(logits, batch["labels"], batch["row_mask"])
+
+    loss, vjp = jax.vjp(row_loss, occ_t)
+    (d_occ,) = vjp(jnp.ones_like(loss))
+    st = state.opt_state["wv"]
+    w_new, n_new, z_new = scatter_ftrl_sorted(
+        d_occ, batch["sorted_slots"], batch["win_off"], wv, st["n"], st["z"],
+        K, cfg.optim.ftrl, cfg.data.sorted_bf16, pack,
+    )
+    metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
+    return (
+        TrainState({"wv": w_new}, {"wv": {"n": n_new, "z": z_new}}, state.step + 1),
+        metrics,
+    )
+
+
+def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool = True,
+                    allow_fused: bool = True) -> Callable:
+    """Returns train_step(state, batch_arrays) -> (state, metrics).
+
+    `allow_fused=False` (the sharded builders) disables the fused
+    scatter+FTRL path regardless of config — the fusion's contract is
+    the single-device step (`_fused_scatter_eligible`)."""
+    fuse = _fused_scatter_eligible(cfg, allow_fused)
 
     def train_step(state: TrainState, batch: dict):
+        # fused path: only for FLAT sorted plans (the batch structure is
+        # static under jit, so this branch resolves at trace time)
+        if fuse and "sorted_slots" in batch and batch["sorted_slots"].ndim == 1:
+            return _fused_fm_step(state, batch, cfg)
+        if fuse and cfg.optim.fused_scatter == "on":
+            raise ValueError(
+                "optim.fused_scatter=on but this batch has no flat sorted "
+                "plan (sorted_layout off/row-major fallback, or stacked "
+                "sub-batch plans) — the fused path cannot run; use auto to "
+                "allow the two-pass form on such batches"
+            )
         loss, grads = jax.value_and_grad(loss_fn)(state.tables, batch, model, cfg)
         new_tables, new_opt = optimizer.apply(state.tables, state.opt_state, grads, cfg)
         metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
