@@ -158,15 +158,18 @@ def test_fused_scatter_ftrl_matches_two_pass():
     and unpacked storage."""
     from xflow_tpu.ops.sorted_table import plan_sorted_batch
 
-    for packed in ("auto", "off"):
+    for model_name, packed in (("fm", "auto"), ("fm", "off"), ("mvm", "auto")):
+        # MVM fuses only under the explicit "on" (auto keeps it two-pass)
         base = {
-            "model.name": "fm", "data.log2_slots": 13, "data.batch_size": 64,
+            "model.name": model_name, "data.log2_slots": 13, "data.batch_size": 64,
             "data.max_nnz": 7, "model.num_fields": 5,
             "data.packed_tables": packed,
         }
-        cfg_f = override(Config(), **base)  # fused_scatter auto
+        mode = "on" if model_name == "mvm" else "auto"
+        cfg_f = override(Config(), **{**base, "optim.fused_scatter": mode})
         cfg_o = override(Config(), **{**base, "optim.fused_scatter": "off"})
-        model, opt = get_model("fm"), get_optimizer("ftrl")
+        model, opt = get_model(model_name), get_optimizer("ftrl")
+        tname = "v" if model_name == "mvm" else "wv"
         rng = np.random.default_rng(0)
         S = 1 << 13
         state_f = init_state(model, opt, cfg_f)
@@ -189,13 +192,13 @@ def test_fused_scatter_ftrl_matches_two_pass():
             state_o, m_o = step_o(state_o, batch)
             np.testing.assert_allclose(float(m_f["loss"]), float(m_o["loss"]), rtol=1e-6)
         np.testing.assert_allclose(
-            np.asarray(state_f.tables["wv"]), np.asarray(state_o.tables["wv"]),
-            rtol=1e-6, atol=1e-8, err_msg=f"fused != two-pass (packed={packed})",
+            np.asarray(state_f.tables[tname]), np.asarray(state_o.tables[tname]),
+            rtol=1e-6, atol=1e-8, err_msg=f"fused != two-pass ({model_name}, packed={packed})",
         )
         for key in ("n", "z"):
             np.testing.assert_allclose(
-                np.asarray(state_f.opt_state["wv"][key]),
-                np.asarray(state_o.opt_state["wv"][key]),
+                np.asarray(state_f.opt_state[tname][key]),
+                np.asarray(state_o.opt_state[tname][key]),
                 rtol=1e-6, atol=1e-8,
             )
 
@@ -232,7 +235,7 @@ def test_fused_scatter_on_fails_loudly_when_ineligible():
         "labels": jnp.zeros(16, jnp.float32),
         "row_mask": jnp.ones(16, jnp.float32),
     }
-    with pytest.raises(ValueError, match="no flat sorted plan"):
+    with pytest.raises(ValueError, match="no flat fields-free sorted plan"):
         step(state, batch)
 
 
@@ -244,3 +247,20 @@ def test_kernel_parity_runs_off_tpu():
 
     par = check_kernel_parity(log2_slots=13, n_occ=1 << 12, batch=256)
     assert par["ok"], par["checks"]
+
+
+def test_fused_scatter_on_rejected_on_mesh_at_startup():
+    """optim.fused_scatter=on on a mesh must fail at Trainer
+    construction (the mesh engines run two-pass; a lazily-built
+    overflow-fallback step raising mid-run would be far worse)."""
+    import pytest
+
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.train.trainer import Trainer
+
+    cfg = override(Config(), **{
+        "model.name": "fm", "data.log2_slots": 14, "mesh.data": 4,
+        "mesh.table": 2, "optim.fused_scatter": "on",
+    })
+    with pytest.raises(ValueError, match="single-device"):
+        Trainer(cfg, mesh=make_mesh(cfg))

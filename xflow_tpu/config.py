@@ -61,10 +61,13 @@ class OptimConfig:
     # throughput-NEUTRAL vs the two-pass form (XLA already fuses that
     # chain; docs/PERF.md lever 5b) — the win is one table-sized
     # transient off peak HBM (738 MB at 2^24 FM). "auto" (default)
-    # fuses when eligible (ftrl + fused FM + flat sorted plan, single
-    # device); "off" keeps the two-pass form. Identical math either
-    # way (equality-tested; the update runs on each window's COMPLETE
-    # gradient block; on-device scatter_ftrl_* parity checks).
+    # fuses the eligible FM config (ftrl + fused FM + flat sorted plan,
+    # single device); "on" additionally covers the MVM product path —
+    # measured ~3% slower there, so its memory win is an explicit
+    # opt-in — and asserts eligibility loudly; "off" keeps the
+    # two-pass form. Identical math either way (equality-tested; the
+    # update runs on each window's COMPLETE gradient block; on-device
+    # scatter_ftrl_* parity checks).
     fused_scatter: str = "auto"
 
 

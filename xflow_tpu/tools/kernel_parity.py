@@ -206,11 +206,22 @@ def check_kernel_parity(
     from xflow_tpu.optim.ftrl import _update_one
 
     hp = FTRLConfig()
-    w0 = pack_table(rng.standard_normal((S, k)).astype(np.float32) * 0.01)
-    n0 = pack_table(np.abs(rng.standard_normal((S, k))).astype(np.float32) * 0.1)
-    z0 = pack_table(rng.standard_normal((S, k)).astype(np.float32) * 1e-4)
+    w0_l = rng.standard_normal((S, k)).astype(np.float32) * 0.01
+    n0_l = np.abs(rng.standard_normal((S, k))).astype(np.float32) * 0.1
+    z0_l = rng.standard_normal((S, k)).astype(np.float32) * 1e-4
+    # exercise the lazy-init guard (g==0 ∧ n==0 keeps w) on device: the
+    # upper half of the table gets NO gradient (its occurrences' d
+    # columns zeroed — scatter of exact zeros) and zero n/z state, so
+    # without the guard the closed form would zero those w's; the fused
+    # kernel must keep the inits bitwise like the two-pass reference
+    n0_l[S // 2:] = 0.0
+    z0_l[S // 2:] = 0.0
+    w0 = pack_table(w0_l)
+    n0 = pack_table(n0_l)
+    z0 = pack_table(z0_l)
     d_f = (rng.standard_normal((_k8(k), Np)).astype(np.float32)
-           * np.asarray(plan.sorted_mask)[None, :])
+           * np.asarray(plan.sorted_mask)[None, :]
+           * (np.asarray(plan.sorted_slots) < S // 2)[None, :])
     # the DISPATCHING wrapper: Pallas on TPU, the two-pass composition
     # elsewhere — so this gate keeps running (trivially) off-TPU, per
     # the module contract

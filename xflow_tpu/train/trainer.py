@@ -166,6 +166,18 @@ class Trainer:
         else:
             self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
         if mesh is not None:
+            if cfg.optim.fused_scatter == "on":
+                # fail at STARTUP, not data-dependently: the mesh engines
+                # run the two-pass form (the in-place window kernel's
+                # contract is the single-device step), and the fullshard
+                # overflow fallback builds its GSPMD step lazily — under
+                # "on" that build would raise mid-run on the first skewed
+                # batch of a long job
+                raise ValueError(
+                    "optim.fused_scatter=on requires the single-device "
+                    "step; mesh engines run the two-pass form — use auto "
+                    "(fuses where eligible) or off"
+                )
             from xflow_tpu.parallel.train_step import make_sharded_train_step, make_sharded_eval_step, shard_state
 
             if self._mesh_engine == "fullshard":
