@@ -183,9 +183,10 @@ def main() -> int:
         segment-sum path — recorded as the `mvm_dupfields_*` companion.
 
         FFM benches at its practical shape — 18 one-feature-per-field
-        fields, k=4 per opposing field (a [S, 73] fused row), B capped
-        at 16k: its per-(row, field) segment state is nf× a row, so the
-        64k-row shape would be all sub-batch fragmentation.
+        fields, k=4 per opposing field (a [S, 73] fused row) — on the
+        aligned-hybrid sorted engine at the full CLI batch (round 5;
+        the round-4 16k cap was a segment-engine argument and the
+        hybrid has no segment state).
 
         `log2_slots`/`batch`/`nnz` override the CLI shape (0 = CLI) —
         the 2^24 north-star companion runs use them.
@@ -259,22 +260,20 @@ def main() -> int:
                 else:
                     print(f"# {name}: dedup overflow (uniques > {cap}); direct",
                           file=sys.stderr)
-            if name in ("fm", "mvm") and not args.no_sorted:
-                # (FFM deliberately absent: its single-device default IS
-                # the row-major einsum path — the sorted segment engine
-                # measured slower there, docs/PERF.md round-4 #5 — so
-                # this benches what `xflow train --model ffm` runs)
+            if name in ("fm", "mvm", "ffm") and not args.no_sorted:
                 # sorted-window layout (ops/sorted_table.py): host-side
-                # plan, sub-batched like the trainer (cache-resident rows)
+                # plan, sub-batched like the trainer (cache-resident rows).
+                # FFM rides the ALIGNED HYBRID (round 5, models/ffm.py):
+                # flat plan with fields + the host placement permutation
                 from xflow_tpu.ops.sorted_table import (
                     plan_sorted_stacked,
                     resolve_sub_batches,
                 )
 
-                ns = resolve_sub_batches(cfg)
-                # only the MVM segment path consumes per-occurrence fields;
-                # the product path routes on their absence (models/mvm.py)
-                use_fields = name == "mvm" and dup_fields
+                ns = 1 if name == "ffm" else resolve_sub_batches(cfg)
+                # the MVM segment path and FFM consume per-occurrence
+                # fields; the MVM product path routes on their absence
+                use_fields = name == "ffm" or (name == "mvm" and dup_fields)
                 plans = [
                     plan_sorted_stacked(
                         slots_np[i], mask_np[i], cfg.num_slots,
@@ -286,6 +285,8 @@ def main() -> int:
                 path = (
                     f"sorted layout ({'segment' if use_fields else 'product'})"
                     if name == "mvm"
+                    else "sorted layout (aligned hybrid)"
+                    if name == "ffm"
                     else "sorted layout"
                 )
                 print(f"# {name}: {path}, sub_batches={ns}", file=sys.stderr)
@@ -296,6 +297,20 @@ def main() -> int:
                 if use_fields:
                     batches["sorted_fields"] = jnp.asarray(
                         np.stack([p.sorted_fields for p in plans])
+                    )
+                if name == "ffm":
+                    from xflow_tpu.models.ffm import ffm_invperm
+
+                    batches["ffm_invperm"] = jnp.asarray(
+                        np.stack(
+                            [
+                                ffm_invperm(
+                                    p.sorted_row, p.sorted_fields,
+                                    p.sorted_mask, B_, cfg.model.num_fields,
+                                )
+                                for p in plans
+                            ]
+                        )
                     )
             return batches
 
@@ -360,10 +375,16 @@ def main() -> int:
     models = ["lr", "fm", "mvm"] if args.model == "all" else [args.model]
 
     def model_shape(name: str) -> dict:
-        # FFM always benches at its practical shape (bench_model
-        # docstring) — also under an explicit --model ffm
+        # FFM benches at its practical shape — 18 one-feature-per-field
+        # fields, k=4 — at 2x the CLI batch: wide-row models amortize
+        # the per-step table-sized passes over more examples (measured
+        # at 2^22: 64k -> 623k ex/s, 128k -> 742k, 192k OOM, 256k hits
+        # the Mosaic compile-helper limit), and the aligned hybrid
+        # carries no per-(row, field) segment state, so the round-4 16k
+        # cap (a sorted-segment-engine argument) no longer applies.
+        # 128k is also the recommended trainer batch for FFM.
         if name == "ffm":
-            return {"batch": min(args.batch, 16384), "nnz": 18}
+            return {"batch": args.batch * 2, "nnz": 18}
         return {}
     # skewed-slot (Zipf alpha=1.05) runs ride along (round-1 verdict item
     # 9): real CTR id streams are heavy-tailed, and uniform slots are the
@@ -396,8 +417,8 @@ def main() -> int:
         )
     if args.model == "all":
         # FFM companion (BASELINE.json config 5) at its practical shape
-        # (bench_model docstring): B=16k, 18 one-feature-per-field
-        # fields, k=4 — a [S, 73] fused row
+        # (bench_model docstring): 18 one-feature-per-field fields, k=4
+        # — a [S, 73] fused row on the aligned hybrid engine
         ffm = bench_model("ffm", ("uniform",), **model_shape("ffm"))
         record["ffm_examples_per_sec"] = round(ffm["uniform"], 1)
         record["ffm_vs_baseline"] = round(ffm["uniform"] / PER_CHIP_TARGET, 3)
@@ -412,14 +433,29 @@ def main() -> int:
                 record[f"{name}_s24_vs_baseline"] = round(
                     r24["uniform"] / PER_CHIP_TARGET, 3
                 )
+            # FFM at 2^24 cannot run on one chip: the FTRL state is
+            # 3 x [2^21, 584] f32 = 29.4 GB against ~15 GB of HBM (the
+            # [S, 73] fused row is 6.6x FM's). At-scale FFM is the
+            # fullshard mesh path (2^24 over 64 chips = 460 MB/chip);
+            # recorded as a note so the absence is explicit, not silent
+            record["ffm_s24_note"] = (
+                "infeasible single-chip: FTRL state 3x9.8GB > 15GB HBM; "
+                "at-scale FFM = fullshard mesh (dryrun leg covers it)"
+            )
         if not args.smoke and not args.sorted_bf16:
-            # bf16 fast-mode rider (cfg.data.sorted_bf16, docs/PERF.md
+            # bf16 fast-mode riders (cfg.data.sorted_bf16, docs/PERF.md
             # "Precision note"): the one-pass MXU read the exact default
             # deliberately forgoes — recorded so the trade stays visible
             b16 = bench_model("fm", ("uniform",), sorted_bf16=True)
             record["fm_bf16_examples_per_sec"] = round(b16["uniform"], 1)
             record["fm_bf16_vs_baseline"] = round(
                 b16["uniform"] / PER_CHIP_TARGET, 3
+            )
+            f16 = bench_model("ffm", ("uniform",), sorted_bf16=True,
+                              **model_shape("ffm"))
+            record["ffm_bf16_examples_per_sec"] = round(f16["uniform"], 1)
+            record["ffm_bf16_vs_baseline"] = round(
+                f16["uniform"] / PER_CHIP_TARGET, 3
             )
         if not args.smoke:
             # end-to-end rider (round-3 verdict #5): disk → C++ parser →

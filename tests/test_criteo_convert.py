@@ -148,3 +148,45 @@ def test_convert_avazu_no_header(tmp_path):
     assert stats == {"rows": 2, "skipped": 0, "fields": 3}
     first = (tmp_path / "av-00000").read_text().strip().split("\n")[0]
     assert first.startswith("0\t0:A0_14102100:1")
+
+
+def test_dirty_categorical_values_escaped_not_mistokenized():
+    """A categorical value containing libffm structural characters
+    (whitespace, ':', '%') must emit a WELL-FORMED token — escaped
+    injectively, so distinct dirty values stay distinct (round-4
+    ADVICE: unsanitized interpolation mis-tokenized downstream)."""
+    from xflow_tpu.tools.criteo_convert import _sanitize, avazu_line_to_libffm
+
+    dirty = ["a b", "a:b", "a%3Ab", "a\tb", "a%b"]
+    sanitized = [_sanitize(v) for v in dirty]
+    # injective and structurally clean
+    assert len(set(sanitized)) == len(dirty)
+    for s in sanitized:
+        assert not any(c in s for c in " \t:"), s
+    # a clean value can never collide with an escaped one ('%' escaped)
+    assert _sanitize("a%3Ab") != "a%3Ab"
+    assert _sanitize("clean") == "clean"
+    # through the real converters: every token still parses 3-way
+    line = "0\t" + "\t".join([""] * N_INT) + "\t" + "\t".join(
+        ["has space", "has:colon"] + [""] * (N_CAT - 2)
+    )
+    out = criteo_line_to_libffm(line)
+    toks = out.split("\t")[1].split(" ")
+    assert len(toks) == 2
+    for t in toks:
+        assert len(t.split(":")) == 3, t
+    av = avazu_line_to_libffm("id,1,x y,w:z\n", 2)
+    for t in av.split("\t")[1].split(" "):
+        assert len(t.split(":")) == 3, t
+
+
+def test_convert_shard_count_beyond_fd_limit_raises_early(tmp_path):
+    """--shards beyond the process fd budget must fail with the clear
+    up-front error, not EMFILE mid-stream (round-4 ADVICE)."""
+    import resource
+
+    soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    if soft == resource.RLIM_INFINITY or soft > 1 << 20:
+        pytest.skip("no practical fd limit on this host")
+    with pytest.raises(ValueError, match="fd limit"):
+        convert(iter([]), str(tmp_path / "x"), int(soft), fmt="avazu")

@@ -168,3 +168,121 @@ def test_ffm_table_specs_and_init():
     assert logical.shape == (S, K)
     assert np.all(logical[:, 0] == 0.0)  # w column
     assert np.std(logical[:, 1:]) > 0  # v blocks random
+
+
+def aligned_batch(rng, B=64, nf=NF):
+    """One occurrence per field (columns == fields), random subset
+    masked — libffm's natural shape, what the aligned hybrid requires."""
+    return {
+        "slots": rng.integers(0, S, (B, nf)).astype(np.int32),
+        "fields": np.broadcast_to(np.arange(nf, dtype=np.int32), (B, nf)).copy(),
+        "mask": (rng.random((B, nf)) < 0.7).astype(np.float32),
+        "labels": (rng.random(B) < 0.4).astype(np.float32),
+        "row_mask": np.ones((B,), np.float32),
+    }
+
+
+def hybrid_arrays(b, nf=NF):
+    from xflow_tpu.models.ffm import ffm_invperm
+    from xflow_tpu.ops.sorted_table import plan_sorted_batch
+
+    plan = plan_sorted_batch(b["slots"], b["mask"], S, fields=b["fields"])
+    return {
+        "labels": jnp.asarray(b["labels"]),
+        "row_mask": jnp.asarray(b["row_mask"]),
+        "sorted_slots": jnp.asarray(plan.sorted_slots),
+        "sorted_row": jnp.asarray(plan.sorted_row),
+        "sorted_mask": jnp.asarray(plan.sorted_mask),
+        "sorted_fields": jnp.asarray(plan.sorted_fields),
+        "win_off": jnp.asarray(plan.win_off),
+        "ffm_invperm": jnp.asarray(
+            ffm_invperm(plan.sorted_row, plan.sorted_fields,
+                        plan.sorted_mask, b["labels"].shape[0], nf)
+        ),
+    }
+
+
+@pytest.mark.parametrize("packed", ["off", "auto"])
+@pytest.mark.parametrize("fused", ["auto", "off"])
+def test_aligned_hybrid_step_matches_row_major(packed, fused):
+    """Full train-step equality: the round-5 aligned hybrid (windowed
+    gather + placement permutation + MXU selector row side, fused
+    scatter+FTRL under `auto`) vs the row-major autodiff oracle path,
+    across storage layouts and with/without the fused optimizer."""
+    over = {"data.packed_tables": packed, "optim.fused_scatter": fused,
+            "data.batch_size": 64, "data.max_nnz": NF}
+    cfg_h = ffm_cfg(**{"data.sorted_layout": "on", **over})
+    cfg_r = ffm_cfg(**{"data.sorted_layout": "off", **over})
+    model, opt = get_model("ffm"), get_optimizer("ftrl")
+    rng = np.random.default_rng(7)
+    batches = [aligned_batch(rng) for _ in range(3)]
+    state_h, state_r = init_state(model, opt, cfg_h), init_state(model, opt, cfg_r)
+    step_h, step_r = make_train_step(model, opt, cfg_h), make_train_step(model, opt, cfg_r)
+    for b in batches:
+        state_h, m_h = step_h(state_h, hybrid_arrays(b))
+        state_r, m_r = step_r(state_r, {k: jnp.asarray(v) for k, v in b.items()})
+        np.testing.assert_allclose(float(m_h["loss"]), float(m_r["loss"]), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_h.tables["wv"]).reshape(-1),
+        np.asarray(state_r.tables["wv"]).reshape(-1),
+        rtol=2e-4, atol=1e-6,
+    )
+    for part in ("n", "z"):
+        np.testing.assert_allclose(
+            np.asarray(state_h.opt_state["wv"][part]).reshape(-1),
+            np.asarray(state_r.opt_state["wv"][part]).reshape(-1),
+            rtol=2e-4, atol=1e-6,
+        )
+
+
+def test_aligned_hybrid_untouched_slots_bitwise_initial():
+    """FTRL lazy-init parity through the hybrid: slots no batch touches
+    must keep their initial weights BITWISE (the selector-contraction
+    VJP is exact at structural zeros — make_ffm_aligned_op docstring)."""
+    from xflow_tpu.ops.sorted_table import pack_of, unpack_table
+
+    cfg = ffm_cfg(**{"data.sorted_layout": "on", "data.batch_size": 32,
+                     "data.max_nnz": NF})
+    model, opt = get_model("ffm"), get_optimizer("ftrl")
+    rng = np.random.default_rng(11)
+    b = aligned_batch(rng, B=32)
+    state0 = init_state(model, opt, cfg)
+    K = 1 + NF * K_LAT
+    w0 = np.asarray(unpack_table(state0.tables["wv"], K))
+    state, _ = make_train_step(model, opt, cfg)(state0, hybrid_arrays(b))
+    w1 = np.asarray(unpack_table(state.tables["wv"], K))
+    touched = np.zeros(S, bool)
+    touched[np.unique(b["slots"][b["mask"] > 0])] = True
+    assert (w1[~touched] == w0[~touched]).all(), "untouched slots moved"
+    assert not np.array_equal(w1[touched], w0[touched])
+
+
+def test_trainer_routes_ffm_sorted_and_falls_back_on_dup(tmp_path):
+    """Trainer auto: FFM now takes the sorted hybrid; a duplicate-field
+    batch runs the row-major fallback in the same run; sorted_layout=on
+    rejects duplicate-field batches with the clear error."""
+    from xflow_tpu.data.schema import SparseBatch
+    from xflow_tpu.train.trainer import Trainer
+
+    cfg = ffm_cfg(**{"data.batch_size": 16, "data.max_nnz": NF,
+                     "train.metrics_path": str(tmp_path / "m.jsonl")})
+    t = Trainer(cfg)
+    assert t._sorted, "FFM auto should select the sorted hybrid now"
+    rng = np.random.default_rng(3)
+    b = aligned_batch(rng, B=16)
+    sb = SparseBatch(slots=b["slots"], fields=b["fields"], mask=b["mask"],
+                     labels=b["labels"], row_mask=b["row_mask"])
+    arrays = t._batch_arrays(sb)
+    assert "ffm_invperm" in arrays and "sorted_slots" in arrays
+    dup = dict(b)
+    dup["fields"] = dup["fields"].copy()
+    dup["fields"][:, 1] = 0  # field 0 twice
+    dup["mask"] = np.ones_like(dup["mask"])
+    sbd = SparseBatch(slots=dup["slots"], fields=dup["fields"], mask=dup["mask"],
+                      labels=dup["labels"], row_mask=dup["row_mask"])
+    arrays_dup = t._batch_arrays(sbd)
+    assert "sorted_slots" not in arrays_dup and "slots" in arrays_dup
+
+    t_on = Trainer(override(cfg, **{"data.sorted_layout": "on"}))
+    with pytest.raises(ValueError, match="aligned"):
+        t_on._batch_arrays(sbd)

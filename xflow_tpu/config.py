@@ -86,7 +86,7 @@ class ModelConfig:
     not replicate. Default is the standard form.
     """
 
-    name: str = "lr"  # "lr" | "fm" | "mvm"
+    name: str = "lr"  # "lr" | "fm" | "mvm" | "ffm"
     v_dim: int = 10
     num_fields: int = 18
     # MVM exclusive-fields product path (models/mvm.py): when every
@@ -95,11 +95,15 @@ class ModelConfig:
     # row's occurrences, computed through the same cache-resident
     # [B, ~24] row-sum kernel FM uses instead of the [B·nf, k+1]
     # segment aggregate (the measured MVM wall, docs/PERF.md 3a).
-    # "auto": check each batch on the host; route duplicate-field
-    # batches to the segment path (single-process) or raise
-    # (multi-process — per-batch routing would desync the ranks'
-    # collective programs). "on": require exclusive fields (raise on
-    # duplicates). "off": always the general segment path.
+    # "auto": check each batch on the host and route duplicate-field
+    # batches to the segment path. Single-process routes locally; the
+    # multi-process fullshard engine coordinates the per-batch choice
+    # through a rank-symmetric flag allgather
+    # (trainer._resolve_fullshard_overflow) so every rank picks the
+    # same mode; other multi-process engines raise on duplicates (no
+    # coordination point — models/mvm.py resolve_mvm_product). "on":
+    # require exclusive fields (raise on duplicates). "off": always
+    # the general segment path.
     mvm_exclusive: str = "auto"
     # MVM factor form: False = plain view-sum product Π_f s (the
     # reference's live forward, mvm_worker.cc:202); True = Π_f (1 + s),

@@ -34,22 +34,22 @@ through ⟨v_{i,c}, v_{j,c}⟩, which IS the textbook term since f_j = c.
 and the diagonal counts same-field pairs twice plus the self terms;
 halving and subtracting the selves leaves exactly Σ_{i<j}.)
 
-Memory note: S is [B, nf, nf, k] — ~332 MB at B = 64k, nf = 18, k = 4
-(transient; fine on a 16 GB chip, and the fullshard mesh path never
-builds it).
-
-Path choice (measured, docs/PERF.md round-4 #5): on ONE device the
-row-major MXU path is FASTER than the sorted segment engine at the
-practical shape (193k vs 123k ex/s), so `sorted_layout=auto` keeps FFM
-row-major; the segment mode is the fullshard MESH engine's row side,
-where the no-replication layout requires it. Known limitation of the
-FORCED single-device sorted path (`sorted_layout=on`): at very wide
-fused rows with large batches (observed at nf·k = 128, B = 64k,
-2^22 slots) XLA's TPU compiler crashes building the fused program —
-the windowed kernels and the segment row side each compile fine in
-isolation at that exact shape, so this is a compiler-scale issue, not
-a kernel one. The default (`auto`) path and the practical bench shape
-(nf·k = 72) are unaffected.
+Path choice (round 5, measured — docs/PERF.md): on ONE device
+`sorted_layout=auto` (and `on`) runs the ALIGNED HYBRID sorted engine
+(`make_ffm_aligned_op` below): windowed table gather + host placement
+permutation + layout-friendly MXU row side + fused scatter+FTRL —
+512k ex/s at B = 64k, 2^22 slots (565k with `data.sorted_bf16`),
+vs 193k for the round-4 row-major einsum path at its 16k cap.
+Batches with duplicate (row, field) occurrences fall back per batch
+to the row-major einsum path in `forward` (the general form, itself
+layout-rewritten this round: 282k at 16k where round 4's 4-D einsum
+formulation measured 191k and OOM'd at 64k). The per-(row, field)
+SEGMENT engine (`make_ffm_row_op`) is the fullshard MESH engine's row
+side only, where the no-replication layout requires it — the round-4
+single-device forced-sorted segment path (and the XLA compiler crash
+it hit at nf·k = 128, B = 64k) no longer exists: `sorted_layout=on`
+now means the hybrid, and rejects non-aligned batches with a clear
+error (trainer._resolve_ffm_aligned).
 """
 
 from __future__ import annotations
@@ -205,6 +205,8 @@ def _forward_sorted(tables, batch, cfg):
 
     wv = tables["wv"]
     nf, k = _dims(cfg)
+    if "ffm_invperm" in batch:
+        return _forward_sorted_aligned(wv, batch, cfg)
     return sorted_gather_map(
         wv, batch, ("sorted_row", "sorted_mask", "sorted_fields"),
         batch["labels"].shape[0],
@@ -213,30 +215,257 @@ def _forward_sorted(tables, batch, cfg):
     )
 
 
+# ---------------------------------------------------------------------------
+# Aligned hybrid path (the single-device FFM engine since round 5).
+#
+# On aligned batches — at most ONE masked occurrence per (row, field),
+# libffm's natural shape and what the bundled/bench data always is —
+# the per-(row, field) "segment sum" is a pure PLACEMENT, so the row
+# side never needs the segment engine: the windowed sorted gather
+# (table streamed once per step) hands occ_t [K8, Np] in slot order,
+# one host-planned inverse permutation places it as A [B, nfp, K8]
+# (nfp = nf rounded up to the 8-sublane multiple, so [B·nfp, K8] →
+# [B, nfp, K8] is a free view — no lane-boundary reshape anywhere),
+# and the pairwise term is ONE MXU contraction against a static 0/1
+# selector built in-graph (never a captured constant: jit-embedded
+# arrays ship through the remote-compile tunnel).
+#
+# Measured at B = 64k, 2^22 slots (round-5 probes, docs/PERF.md):
+# round-4 row-major 4-D einsum path OOMs; the layout-fixed row-major
+# path runs 240k ex/s; this hybrid runs 512k exact / 565k with
+# data.sorted_bf16 — the step decomposition is gather 21.8 ms +
+# place 16 + row math 28 + backward 32 + fused scatter+FTRL 31.
+# ---------------------------------------------------------------------------
+
+
+def nf_padded(nf: int) -> int:
+    """nf rounded to the 8-sublane multiple (see the layout note) —
+    the same rounding rule as the kernels' channel padding."""
+    from xflow_tpu.ops.sorted_table import _k8
+
+    return _k8(nf)
+
+
+def ffm_invperm(sorted_row, sorted_fields, sorted_mask, rows: int, nf: int):
+    """HOST-side placement permutation for an aligned plan: int32
+    [rows·nfp] mapping destination (row, field) → its sorted position,
+    absent pairs → Np-1 (always a pad position: plans carry one spare
+    chunk, ops/sorted_table.padded_len). Raises on duplicate (row,
+    field) pairs — callers route those batches elsewhere
+    (resolve_ffm_aligned)."""
+    import numpy as np
+
+    nfp = nf_padded(nf)
+    Np = sorted_row.shape[0]
+    inv = np.full(rows * nfp, Np - 1, np.int32)
+    real = np.asarray(sorted_mask) > 0
+    dest = (
+        np.asarray(sorted_row)[real].astype(np.int64) * nfp
+        + np.asarray(sorted_fields)[real]
+    )
+    inv[dest] = np.nonzero(real)[0].astype(np.int32)
+    # duplicate detection without a sort: duplicates overwrite one slot,
+    # so fewer occupied destinations than real occurrences ⇔ collision
+    # (real positions are never Np-1 — the plan's spare pad chunk)
+    if int((inv != Np - 1).sum()) != dest.size:
+        raise ValueError(
+            "ffm_invperm: duplicate (row, field) occurrence in an "
+            "aligned plan — route duplicate-field batches to the "
+            "general path (resolve_ffm_aligned)"
+        )
+    return inv
+
+
+def has_field_duplicates(fields, mask) -> bool:
+    """True when any row carries two masked occurrences of one field
+    (shared host check — same definition as models/mvm.py's)."""
+    from xflow_tpu.models.mvm import has_field_duplicates as _h
+
+    return _h(fields, mask)
+
+
+def resolve_ffm_aligned(batch_fields, batch_mask) -> bool:
+    """Route one FFM batch: aligned hybrid (True) or the row-major
+    general path (False). Host-side per batch, like MVM's product
+    routing: the hybrid requires ≤1 masked occurrence per (row, field).
+    Duplicate-field batches run the layout-fixed row-major einsum path
+    (the general form; measured 282k ex/s at 16k vs the sorted segment
+    engine's 123k — docs/PERF.md round 5)."""
+    return not has_field_duplicates(batch_fields, batch_mask)
+
+
+def _pair_selector(nf: int, k: int, nfp: int, k8: int, dtype):
+    """Static 0/1 selector tensors for the aligned row side, built
+    IN-GRAPH from iota/compares (a captured 14.7 MB constant would ship
+    through the tunnel's remote_compile on every cache miss):
+
+      T [nfp, k8, nfp, k8]: T[c1, 1+c2·k+kk, c2, 1+c1·k+kk] = 1
+      Q [nfp, k8]:          own-block select (column block c of row c)
+      W [nfp, k8]:          the w channel (column 0, real fields only)
+    """
+    c = jnp.arange(nfp)[:, None, None, None]  # c1
+    e = jnp.arange(k8)[None, :, None, None]
+    d = jnp.arange(nfp)[None, None, :, None]  # c2
+    f = jnp.arange(k8)[None, None, None, :]
+    ke = e - 1 - d * k  # kk from e given c2=d
+    kf = f - 1 - c * k  # kk from f given c1=c
+    T = (
+        (ke == kf) & (ke >= 0) & (ke < k) & (c < nf) & (d < nf)
+    ).astype(dtype)
+    cq = jnp.arange(nfp)[:, None]
+    eq = jnp.arange(k8)[None, :]
+    kq = eq - 1 - cq * k
+    Q = ((kq >= 0) & (kq < k) & (cq < nf)).astype(dtype)
+    W = ((eq == 0) & (cq < nf)).astype(dtype)
+    return T, Q, W
+
+
+def make_ffm_aligned_op(nf: int, k: int, k8: int, rows: int):
+    """Build the aligned row-side op:
+
+        op(occ_t [K8, Np], invperm [rows·nfp], src [Np], smask [Np])
+            -> logits [rows]
+
+    occ_t is the slot-sorted windowed gather output; `invperm` places
+    it (ffm_invperm); `src` = sorted_row·nfp + sorted_field is the
+    reverse map. The placement carries a HAND-WRITTEN VJP: the
+    transpose of a (partial) permutation gather is the reverse gather —
+    d_occ[:, p] = d_A[src[p]]·smask[p] — never an XLA scatter (which
+    would pay ~35 ns/row random-write latency for what is a
+    permutation).
+
+    Exactness at FTRL's zeros (the lazy-init parity class both sibling
+    ops document): d_A = dl·(X − A·Q + W) with X = T(A); for a
+    single-occupant field, X at the self position is bitwise A (the
+    selector row is one-hot, and the f32-exact 3-pass contraction
+    reconstructs the operand exactly), so the subtraction is EXACTLY
+    zero; absent fields have A = 0 ⇒ X = 0. Equality-tested against
+    the row-major oracle path."""
+    nfp = nf_padded(nf)
+
+    def rowmath(A, T, Q, W):
+        X = jnp.einsum(
+            "bce,cedf->bdf", A, T, precision=jax.lax.Precision.HIGHEST
+        )
+        full = (A * X).sum((-1, -2))
+        qsum = (A * A * Q[None]).sum((-1, -2))
+        wx = (A * W[None]).sum((-1, -2))
+        return wx + 0.5 * (full - qsum)
+
+    @jax.custom_vjp
+    def place(occ_t, invperm, src, smask):
+        dead = (invperm != occ_t.shape[1] - 1).astype(occ_t.dtype)
+        return (occ_t.T[invperm] * dead[:, None]).reshape(rows, nfp, k8)
+
+    def _fwd(occ_t, invperm, src, smask):
+        return place(occ_t, invperm, src, smask), (src, smask)
+
+    def _bwd(res, d_A):
+        src, smask = res
+        d_occ = (d_A.reshape(rows * nfp, k8)[src] * smask[:, None]).T
+        return d_occ, None, None, None
+
+    place.defvjp(_fwd, _bwd)
+
+    def op(occ_t, invperm, src, smask):
+        T, Q, W = _pair_selector(nf, k, nfp, k8, occ_t.dtype)
+        A = place(occ_t, invperm, src, smask)
+        return rowmath(A, T, Q, W)
+
+    return op
+
+
+def ffm_aligned_logits(occ_t, batch, cfg):
+    """Row-side logits for an aligned-hybrid batch, from the gathered
+    occ_t — shared by the fused train step (train/step.py), the plain
+    autodiff forward below, and eval."""
+    from xflow_tpu.ops.sorted_table import _k8, wire_mask, wire_rows
+
+    nf, k = _dims(cfg)
+    nfp = nf_padded(nf)
+    rows = batch["labels"].shape[0]
+    smask = wire_mask(batch["sorted_mask"])
+    src = wire_rows(batch["sorted_row"]) * nfp + wire_rows(batch["sorted_fields"])
+    op = make_ffm_aligned_op(nf, k, _k8(1 + nf * k), rows)
+    return op(occ_t, batch["ffm_invperm"], src, smask)
+
+
+def _forward_sorted_aligned(wv, batch, cfg):
+    from xflow_tpu.ops.sorted_table import pack_of, table_gather_sorted
+
+    nf, k = _dims(cfg)
+    K = 1 + nf * k
+    occ_t = table_gather_sorted(
+        wv, batch["sorted_slots"], batch["win_off"], cfg.data.sorted_bf16,
+        pack_of(wv, K),
+    )
+    return ffm_aligned_logits(occ_t, batch, cfg)
+
+
+def block_transpose_perm(nf: int, k: int):
+    """Static involution on the flattened [nf·nf·k] S index:
+    (c1, c2, kk) ↔ (c2, c1, kk). Applying it as a minor-dim gather is
+    how the pairwise contraction avoids ever materializing S as a 4-D
+    [B, nf, nf, k] tensor — see `forward`'s layout note."""
+    import numpy as np
+
+    c1, c2, kk = np.meshgrid(
+        np.arange(nf), np.arange(nf), np.arange(k), indexing="ij"
+    )
+    return jnp.asarray(
+        (c2 * nf * k + c1 * k + kk).reshape(-1).astype(np.int32)
+    )
+
+
 def forward(tables, batch, cfg):
+    """Row-major FFM forward in LAYOUT-FRIENDLY 3-D shapes.
+
+    TPU HBM buffers are (8, 128)-tiled, so any tensor whose minor dim
+    is the latent width k (4 at the practical shape) is stored at
+    128/k× its logical bytes. The original formulation materialized
+    [B, F, nf, k] and [B, nf, nf, k] einsum operands — ~3.5 GB EACH at
+    B = 16k once padded, which made fwd+bwd the measured step wall
+    (round-5 probe: fwd 30 ms, bwd 46 ms of an 86 ms step) and OOM'd
+    outright at B = 64k. This formulation keeps every operand's minor
+    dim ≥ nf·k = 72:
+
+      vm [B, F, nf·k]   masked v blocks (block c = the feature's vector
+                        against field c)
+      S  [B, nf, nf·k]  = einsum over occurrences with the field
+                        one-hot — S[b, c1, c2·k+kk] = S4[b, c1, c2, kk]
+      full              = Σ Sf · Sf[:, PERM] where PERM is the static
+                        (c1,c2)-block-transpose involution
+                        (block_transpose_perm) on the flattened minor
+                        dim — the pairwise ⟨S[c1,c2], S[c2,c1]⟩ sum
+                        with no 4-D transpose ever stored
+      qsum              = Σ (vm²·own-block select), the self-norm term,
+                        one fused elementwise pass
+
+    Same math as the module docstring's field-sum proof; the einsums
+    run f32-exact (HIGHEST)."""
     if "sorted_slots" in batch:
         return _forward_sorted(tables, batch, cfg)
     from xflow_tpu.ops.sorted_table import batch_rows
 
     nf, k = _dims(cfg)
+    E = nf * k
     mask = batch["mask"]
-    wvg = batch_rows(tables["wv"], batch, 1 + nf * k)  # [B, F, 1+nf*k]
+    wvg = batch_rows(tables["wv"], batch, 1 + E)  # [B, F, 1+nf*k]
     wx = (wvg[..., 0] * mask).sum(axis=-1)
     B, F = mask.shape
-    v = (wvg[..., 1:] * mask[..., None]).reshape(B, F, nf, k)
-    onehot = (batch["fields"][..., None] == jnp.arange(nf)).astype(v.dtype)
+    vm = wvg[..., 1:] * mask[..., None]  # [B, F, E]
+    onehot = (batch["fields"][..., None] == jnp.arange(nf)).astype(vm.dtype)
     onehot = onehot * mask[..., None]  # [B, F, nf]
-    # S[b, c1, c2, :]: one MXU contraction over the occurrence axis
     S = jnp.einsum(
-        "bfc,bfdk->bcdk", onehot, v, precision=jax.lax.Precision.HIGHEST
-    )
-    full = jnp.einsum(
-        "bcdk,bdck->b", S, S, precision=jax.lax.Precision.HIGHEST
-    )
-    vself = jnp.take_along_axis(
-        v, batch["fields"][..., None, None].astype(jnp.int32), axis=2
-    )[:, :, 0, :]  # [B, F, k] — v_{i, f_i}
-    qsum = ((vself * vself).sum(axis=-1) * mask).sum(axis=-1)
+        "bfc,bfe->bce", onehot, vm, precision=jax.lax.Precision.HIGHEST
+    )  # [B, nf, E]
+    Sf = S.reshape(B, nf * E)
+    full = (Sf * Sf[:, block_transpose_perm(nf, k)]).sum(axis=-1)
+    # own-field block select per occurrence: blocksel[b,f,c·k+kk] =
+    # onehot[b,f,c] (a static minor-dim gather that fuses); mask is 0/1
+    # and already folded into both vm and onehot
+    blocksel = jnp.repeat(onehot, k, axis=-1)  # [B, F, E]
+    qsum = (vm * vm * blocksel).sum(axis=(-1, -2))
     return wx + 0.5 * (full - qsum)
 
 

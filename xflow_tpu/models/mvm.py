@@ -143,7 +143,7 @@ def _products_from_sums(S, NC, ZC):
     return jnp.where(ZC > 0, 0.0, sign * jnp.exp(jnp.clip(S, *MVM_LOG_CLIP)))
 
 
-def make_row_products(reduce_rows, broadcast_rows, k: int):
+def make_row_products(reduce_rows, broadcast_rows, k: int, restore_dP=None):
     """Build the exclusive-fields product op:
 
         op(occ_t_k [k, Np], mask [Np], rows [Np]) -> P [R, k]
@@ -166,8 +166,22 @@ def make_row_products(reduce_rows, broadcast_rows, k: int):
     slots as touched. `broadcast_rows` is the bwd's row-aggregate
     transport (identity on one device; all_gather over 'data' in the
     fullshard engine — the same small-row-cotangent traffic class as
-    FM's backward).
+    FM's backward). `restore_dP` undoes any replication-split the
+    engine's transpose applies to the incoming cotangent — the SAME
+    hook, for the same reason, as make_ffm_row_op's `restore_dl`: the
+    fullshard shard_map transpose hands each 'table' copy dP/T (the
+    plain autodiff path restores it through owner_reduce's psum
+    transpose, which a custom bwd bypasses), so the engine passes a
+    psum over 'table'. None = identity (single device). This was NOT a
+    theoretical hole: without the hook the fullshard product path's
+    updates diverged from single-device at every T>1 (measured at
+    (4,2)/(2,4)/(1,8) after 3 steps: loss 0.693127/137/143 vs
+    0.693108, table maxabs err up to 7e-4 and growing with T; exact at
+    (8,1)) — covered by test_sorted_fullshard's product-mode
+    parametrization.
     """
+    restore_dP = restore_dP or (lambda x: x)
+
     @jax.custom_vjp
     def op(occ_t_k, mask, rows):
         P, _ = _fwd(occ_t_k, mask, rows)
@@ -181,6 +195,7 @@ def make_row_products(reduce_rows, broadcast_rows, k: int):
 
     def _bwd(res, dP):
         occ_t_k, mask, rows, sums = res
+        dP = restore_dP(dP)
         per = jnp.take(
             broadcast_rows(jnp.concatenate([dP, sums[:, : 3 * k]], axis=1)),
             rows,

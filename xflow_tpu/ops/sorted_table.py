@@ -168,17 +168,48 @@ def batch_rows(table, batch: dict, K: int):
     return table_rows(table, batch["slots"], K)
 
 
+# packed-row gather intermediate cap (bytes). The packed gather
+# materializes [chunk, pack*K] full packed rows before the sub-row
+# select; at FFM's K=73 a 64k×18 batch would make that ~3 GB in one
+# piece (the round-5 OOM at the 64k row-major shape). Chunking the
+# occurrence axis caps it; 256 MB keeps the per-chunk gather large
+# enough to stay on XLA's fast row-gather path. (A single 2-D
+# lax.gather with a (row, sub-row·K) start index avoids the
+# intermediate entirely but lowers to a ~2.5 µs/row scalar path on
+# TPU — measured 140× slower.)
+_PACKED_GATHER_CHUNK_BYTES = 256 * 1024 * 1024
+
+
 def table_rows(table, slots, K: int):
     """Logical rows ``table[slots]`` from EITHER storage layout — the
     row-major paths' (GSPMD step, mesh eval, non-sorted forwards)
-    layout-blind gather. Packed: one row gather of [..., pack*K] plus an
-    elementwise 0/1 sub-row select (never a matmul, so no MXU operand
-    rounding — see `_sub_select`)."""
+    layout-blind gather. Packed: a full-packed-row gather of
+    [..., pack*K] plus an elementwise 0/1 sub-row select (never a
+    matmul, so no MXU operand rounding — see `_sub_select`), chunked
+    over the occurrence axis so the packed-row intermediate stays
+    under _PACKED_GATHER_CHUNK_BYTES."""
     pack = pack_of(table, K)
     if pack == 1:
         return table[slots]
-    rows = table[slots // pack]
-    return _sub_select(rows, slots % pack, pack, K)
+    flat = slots.reshape(-1)
+    n = flat.shape[0]
+    chunk_rows = max(1, _PACKED_GATHER_CHUNK_BYTES // (pack * K * 4))
+    nch = -(-n // chunk_rows)
+    if nch <= 1:
+        rows = table[flat // pack]
+        out = _sub_select(rows, flat % pack, pack, K)
+    else:
+        pad = nch * chunk_rows - n
+        padded = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+        def one(chunk):
+            rows = table[chunk // pack]
+            return _sub_select(rows, chunk % pack, pack, K)
+
+        out = jax.lax.map(one, padded.reshape(nch, chunk_rows)).reshape(
+            nch * chunk_rows, K
+        )[:n]
+    return out.reshape(*slots.shape, K)
 
 
 def pack_of(table, K: int) -> int:

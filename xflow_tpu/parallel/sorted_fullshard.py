@@ -80,8 +80,12 @@ class FullshardOverflowError(ValueError):
     """An owner block's occurrences exceed the buffer capacity (data more
     skewed than data.fullshard_slack allows). Distinct from other config
     errors so the trainer can fall back to the GSPMD row-major step for
-    the offending batch (single-process only — a per-process fallback
-    would desync the collective programs across ranks)."""
+    the offending batch. Single-process falls back locally; multi-process
+    coordinates the fallback rank-symmetrically — every rank contributes
+    its overflow flag to one per-batch allgather and ALL ranks run the
+    row-major step when any overflowed
+    (trainer._resolve_fullshard_overflow), so the collective programs
+    never desync."""
 
 
 def _dims(cfg: Config, mesh: Mesh):
@@ -363,6 +367,12 @@ def _local_logits(mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
             ),
             lambda arr: jax.lax.all_gather(arr, DATA_AXIS, tiled=True),
             K,
+            # the shard_map transpose hands each 'table' copy dP/T
+            # (make_row_products docstring) — restore before use.
+            # Without this the product path's updates diverged from
+            # single-device at every T>1 (round-4 ADVICE finding,
+            # measured in round 5)
+            restore_dP=lambda dP: jax.lax.psum(dP, TABLE_AXIS),
         )
         return op(occ_t[:K] + plus, mask_flat, grow).sum(axis=1)
     from xflow_tpu.models.fm import fm_logits_from_sums, stack_channels

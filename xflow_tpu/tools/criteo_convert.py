@@ -45,6 +45,20 @@ from typing import Iterator, Optional
 
 N_INT, N_CAT = 13, 26
 
+# libffm token structure: whitespace separates tokens, ':' separates
+# field/feature/value. A raw categorical value containing either would
+# emit a line the downstream parser silently MIS-tokenizes (not skips),
+# so dirty values are escaped injectively: '%' + 2-hex-digit byte for
+# each structural character ('%' itself included so no clean value can
+# collide with an escaped one).
+_BAD = set(" \t\n\r\x0b\x0c:%")
+
+
+def _sanitize(v: str) -> str:
+    if not any(c in _BAD for c in v):
+        return v
+    return "".join("%%%02X" % ord(c) if c in _BAD else c for c in v)
+
 
 def criteo_line_to_libffm(line: str) -> Optional[str]:
     """One raw Criteo TSV line -> one libffm line (None = malformed)."""
@@ -70,7 +84,7 @@ def criteo_line_to_libffm(line: str) -> Optional[str]:
         if not v:
             continue
         f = N_INT + j
-        toks.append("%d:C%d_%s:1" % (f, f, v))
+        toks.append("%d:C%d_%s:1" % (f, f, _sanitize(v)))
     if not toks:
         return None
     return "%s\t%s" % (label, " ".join(toks))
@@ -83,7 +97,7 @@ def avazu_line_to_libffm(line: str, n_fields: int) -> Optional[str]:
     if len(parts) != n_fields + 2 or parts[1] not in ("0", "1"):
         return None
     toks = [
-        "%d:A%d_%s:1" % (f, f, v) for f, v in enumerate(parts[2:]) if v
+        "%d:A%d_%s:1" % (f, f, _sanitize(v)) for f, v in enumerate(parts[2:]) if v
     ]
     if not toks:
         return None
@@ -107,6 +121,21 @@ def convert(
     (the raw Kaggle file) it is consumed as the header, with
     `header=False` (pre-split / tail'ed chunks) it is ALSO converted as
     data — nothing is silently dropped either way."""
+    # round-robin writes touch every shard continuously, so all shard
+    # files stay open for the whole run: check the fd budget up front
+    # instead of dying with EMFILE after validation already passed
+    try:
+        import resource
+
+        soft = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+        if soft != resource.RLIM_INFINITY and num_shards > soft - 16:
+            raise ValueError(
+                f"--shards {num_shards} needs {num_shards} simultaneously "
+                f"open files but the process fd limit is {soft}; raise it "
+                f"(`ulimit -n {num_shards + 64}`) or convert in chunks"
+            )
+    except ImportError:  # non-POSIX: let the OS report it
+        pass
     outs = [open("%s-%05d" % (out_prefix, s), "w") for s in range(num_shards)]
     rows = skipped = 0
     n_fields = N_INT + N_CAT

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import sys
 from typing import Optional
 
 import jax
@@ -283,7 +284,28 @@ def _orbax_stored_shapes(path: str) -> Optional[dict]:
         if tree is None:
             return None
         walk("", tree)
-    except Exception:
+    except json.JSONDecodeError as e:
+        # a corrupt/truncated metadata file is I/O trouble, not an
+        # older metadata-less layout — it must reach the noisy arm
+        # below, and it subclasses ValueError, so catch it FIRST
+        print(
+            f"# checkpoint: metadata read failed (JSONDecodeError: {e}); "
+            "skipping layout-migration detection",
+            file=sys.stderr,
+        )
+        return None
+    except (FileNotFoundError, KeyError, AttributeError, ValueError):
+        # genuinely metadata-less layouts (older orbax) — migration
+        # detection is impossible, callers take the fast path
+        return None
+    except Exception as e:  # I/O trouble is NOT "no metadata": say so
+        # before falling back, or the fast path's eventual shape error
+        # blames the checkpoint layout instead of the real problem
+        print(
+            f"# checkpoint: metadata read failed ({type(e).__name__}: {e}); "
+            "skipping layout-migration detection",
+            file=sys.stderr,
+        )
         return None
     return flat
 

@@ -75,21 +75,25 @@ def _fused_scatter_eligible(cfg: Config, allow_fused: bool) -> bool:
         )
     fm_ok = cfg.model.name == "fm" and cfg.model.fm_fused
     mvm_ok = cfg.model.name == "mvm"
+    ffm_ok = cfg.model.name == "ffm"
     base_ok = allow_fused and cfg.optim.name == "ftrl"
     if cfg.optim.fused_scatter == "on":
-        if not (base_ok and (fm_ok or mvm_ok)):
+        if not (base_ok and (fm_ok or mvm_ok or ffm_ok)):
             raise ValueError(
                 "optim.fused_scatter=on requires the single-device step "
-                "with optim.name=ftrl and model.name=fm (fm_fused=true) or "
-                f"model.name=mvm; got optim={cfg.optim.name} "
+                "with optim.name=ftrl and model.name=fm (fm_fused=true), "
+                f"mvm, or ffm; got optim={cfg.optim.name} "
                 f"model={cfg.model.name} fm_fused={cfg.model.fm_fused} "
                 f"single_device={allow_fused}"
             )
         return True
-    # auto: FM only — measured throughput-NEUTRAL there; the MVM product
-    # path measured ~3% slower fused (41.3 vs 40.0 ms at the bench
-    # shape), so its memory win stays an explicit opt-in ("on")
-    return base_ok and fm_ok
+    # auto: FM (measured throughput-NEUTRAL; kept for the memory win)
+    # and FFM's aligned hybrid (the [S/8, 584]-wide dense gradient +
+    # optimizer sweep it removes is real throughput there — docs/PERF.md
+    # round 5). The MVM product path measured ~3% slower fused (41.3 vs
+    # 40.0 ms at the bench shape), so its memory win stays an explicit
+    # opt-in ("on").
+    return base_ok and (fm_ok or ffm_ok)
 
 
 def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
@@ -103,8 +107,12 @@ def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
     from xflow_tpu.ops.sorted_table import pack_of, scatter_ftrl_sorted, table_gather_sorted
 
     mvm = cfg.model.name == "mvm"
+    ffm = cfg.model.name == "ffm"
     tname = "v" if mvm else "wv"
-    K = cfg.model.v_dim if mvm else 1 + cfg.model.v_dim
+    if ffm:
+        K = 1 + cfg.model.num_fields * cfg.model.v_dim
+    else:
+        K = cfg.model.v_dim if mvm else 1 + cfg.model.v_dim
     table = state.tables[tname]
     pack = pack_of(table, K)
     occ_t = table_gather_sorted(
@@ -118,7 +126,11 @@ def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
         # the gather/scatter seam is split here so the table cotangent
         # feeds the fused kernel
         rows = batch["labels"].shape[0]
-        if mvm:
+        if ffm:
+            from xflow_tpu.models.ffm import ffm_aligned_logits
+
+            logits = ffm_aligned_logits(occ, batch, cfg)
+        elif mvm:
             from xflow_tpu.models.mvm import _product_row_side
 
             plus = 1.0 if cfg.model.mvm_plus_one else 0.0
@@ -159,22 +171,29 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
 
     def train_step(state: TrainState, batch: dict):
         # fused path: only for FLAT sorted plans without per-occurrence
-        # fields (MVM's segment path keeps two-pass) — the batch
-        # structure is static under jit, so this resolves at trace time
-        if (
-            fuse
-            and "sorted_slots" in batch
+        # fields (MVM's segment path keeps two-pass) — except FFM's
+        # aligned hybrid, whose plan carries fields for the placement's
+        # reverse map plus ffm_invperm. Batch structure is static under
+        # jit, so this resolves at trace time
+        fusable = (
+            "sorted_slots" in batch
             and batch["sorted_slots"].ndim == 1
-            and "sorted_fields" not in batch
-        ):
+            and (
+                "ffm_invperm" in batch
+                if cfg.model.name == "ffm"
+                else "sorted_fields" not in batch
+            )
+        )
+        if fuse and fusable:
             return _fused_sorted_step(state, batch, cfg)
         if fuse and cfg.optim.fused_scatter == "on":
             raise ValueError(
                 "optim.fused_scatter=on but this batch has no flat "
                 "fields-free sorted plan (sorted_layout off/row-major "
-                "fallback, stacked sub-batch plans, or MVM's segment "
-                "path) — the fused path cannot run; use auto to allow "
-                "the two-pass form on such batches"
+                "fallback, stacked sub-batch plans, MVM's segment "
+                "path, or a non-aligned FFM batch) — the fused path "
+                "cannot run; use auto to allow the two-pass form on "
+                "such batches"
             )
         loss, grads = jax.value_and_grad(loss_fn)(state.tables, batch, model, cfg)
         new_tables, new_opt = optimizer.apply(state.tables, state.opt_state, grads, cfg)

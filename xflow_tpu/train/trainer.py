@@ -134,14 +134,16 @@ class Trainer:
             supported = (
                 cfg.model.name == "fm" and cfg.model.fm_fused
             ) or cfg.model.name in ("mvm", "ffm")
-            # auto keeps FFM on the row-major einsum path on one device:
-            # its per-(row, field) segment engine measured SLOWER there
-            # (123k vs 193k ex/s at the practical shape, docs/PERF.md
-            # round-4 #5) — the segment mode earns its keep on the
-            # fullshard mesh, where no-replication sharding requires it
-            auto_ok = supported and cfg.model.name != "ffm"
+            # FFM under auto runs the ALIGNED HYBRID sorted engine since
+            # round 5 (models/ffm.py: windowed gather + host placement
+            # permutation + fused scatter+FTRL — 512k ex/s at B=64k vs
+            # the round-4 row-major path's 193k at 16k, docs/PERF.md).
+            # Batches with duplicate (row, field) occurrences fall back
+            # per batch to the layout-fixed row-major einsum path
+            # (_batch_arrays); the old per-(row, field) segment engine
+            # remains the fullshard MESH row side only.
             self._sorted = sl == "on" or (
-                sl == "auto" and auto_ok and cfg.num_slots % WINDOW == 0
+                sl == "auto" and supported and cfg.num_slots % WINDOW == 0
             )
             if sl == "on":
                 # 'on' forces the layout, so reject configurations where it
@@ -164,7 +166,14 @@ class Trainer:
             # one plan per LOCAL data shard; other processes build theirs
             self._sorted_sub = mesh.shape["data"] // jax.process_count()
         else:
-            self._sorted_sub = resolve_sub_batches(cfg) if self._sorted else 1
+            # FFM's aligned hybrid has no per-(row, field) segment
+            # state to keep cache-resident, and its placement permutation
+            # is defined over the whole batch — always one flat plan
+            self._sorted_sub = (
+                1
+                if cfg.model.name == "ffm"
+                else resolve_sub_batches(cfg) if self._sorted else 1
+            )
         if mesh is not None:
             if cfg.optim.fused_scatter == "on":
                 # fail at STARTUP, not data-dependently: the mesh engines
@@ -314,6 +323,35 @@ class Trainer:
         dup = excl != "off" and has_field_duplicates(batch.fields, batch.mask)
         return not resolve_mvm_product(excl, dup, jax.process_count()), None
 
+    def _resolve_ffm_aligned(self, batch) -> bool:
+        """Route one FFM batch: aligned hybrid (True) or the row-major
+        general path (False). Mirrors MVM's product routing contracts:
+        single-process routes per batch; multi-process (non-fullshard)
+        cannot — the two paths' collective programs differ across ranks
+        — so duplicate fields raise there; forced `sorted_layout=on`
+        raises too (the user asserted the sorted engine, and FFM's
+        sorted engine is the aligned hybrid)."""
+        from xflow_tpu.models.ffm import resolve_ffm_aligned
+
+        aligned = resolve_ffm_aligned(batch.fields, batch.mask)
+        if aligned:
+            return True
+        forced = self.cfg.data.sorted_layout == "on"
+        if forced or jax.process_count() > 1:
+            raise ValueError(
+                "FFM aligned hybrid: a row carries two masked occurrences "
+                "of the same field. "
+                + (
+                    "sorted_layout=on requires aligned batches; use auto "
+                    "for the per-batch row-major fallback"
+                    if forced
+                    else "this multi-process configuration cannot fall "
+                    "back per batch (the paths' programs differ across "
+                    "ranks); set data.sorted_layout=off"
+                )
+            )
+        return False
+
     def _batch_arrays(self, batch, with_plan: bool = True) -> dict:
         """SparseBatch -> step input arrays (+ sorted-layout plan).
 
@@ -389,6 +427,12 @@ class Trainer:
         if self._sorted and with_plan:
             from xflow_tpu.ops.sorted_table import plan_sorted_stacked
 
+            if self.cfg.model.name == "ffm" and not self._resolve_ffm_aligned(batch):
+                # duplicate (row, field) occurrence: the aligned hybrid
+                # cannot place this batch — run the row-major general
+                # einsum path for it (single-process per-batch routing,
+                # same pattern as MVM's product fallback)
+                return self._maybe_dedup(arrays, batch)
             arrays = {"labels": arrays["labels"], "row_mask": arrays["row_mask"]}
             want_fields = self.cfg.model.name == "ffm" or (
                 self.cfg.model.name == "mvm" and self._mvm_wants_fields(batch)[0]
@@ -410,6 +454,13 @@ class Trainer:
             )
             if want_fields:
                 arrays["sorted_fields"] = plan.sorted_fields
+            if self.cfg.model.name == "ffm":
+                from xflow_tpu.models.ffm import ffm_invperm
+
+                arrays["ffm_invperm"] = ffm_invperm(
+                    plan.sorted_row, plan.sorted_fields, plan.sorted_mask,
+                    int(arrays["labels"].shape[0]), self.cfg.model.num_fields,
+                )
             from xflow_tpu.ops.sorted_table import compact_plan_wire
 
             arrays = compact_plan_wire(
