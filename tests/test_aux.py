@@ -62,3 +62,27 @@ def test_occupancy_reported(tmp_path, monkeypatch):
     # 80 distinct features in a 4096-slot table, FTRL leaves most touched
     # slots nonzero after enough steps
     assert 0 < res.occupancy["w"] < 0.1
+
+
+def test_fullshard_overflow_sim():
+    """The pod-scale overflow accounting (docs/DISTRIBUTED.md "Sizing
+    fullshard_slack"): rates are monotone in slack, the default slack
+    holds the single-host grid at Criteo-like skew, and the hot-key
+    head share makes D*T=512 need ~p1*D*T (>> any sane default) — the
+    quantified case for the coordinated fallback."""
+    from xflow_tpu.tools.fullshard_overflow_sim import run
+
+    res = run(quick=True)
+    for key, row in res["rows"].items():
+        rates = row["rates"]
+        assert all(a >= b for a, b in zip(rates, rates[1:])), (key, rates)
+    # default slack 2.0 holds D*T=8 at alpha<=1.1 (the docs claim)
+    s_idx = res["slacks"].index(2.0)
+    assert res["rows"]["a1.05_dt8"]["rates"][s_idx] == 0.0
+    # at pod scale the needed slack is dominated by the head share:
+    # far beyond any memory-free default
+    assert res["rows"]["a1.05_dt512"]["needed_slack"] > 8
+    assert (
+        res["rows"]["a1.3_dt512"]["needed_slack"]
+        > res["rows"]["a1.05_dt512"]["needed_slack"]
+    )

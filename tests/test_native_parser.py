@@ -248,3 +248,34 @@ def test_native_throughput_sanity(tmp_path):
     t_python = time.perf_counter() - t0
     assert nb == pb
     assert t_native < t_python, (t_native, t_python)
+
+
+def test_plan_sorted_wire_parity():
+    """xf_plan_sorted_wire emits compact_plan_wire's dtypes directly and
+    matches the int32 planner bit-for-bit (values), incl. pads; the
+    wire contract violations (row >= 2^16 impossible here; non-0/1
+    mask) raise loudly."""
+    import numpy as np
+    import pytest
+
+    from xflow_tpu.ops.sorted_table import plan_sorted_batch
+
+    rng = np.random.default_rng(5)
+    S = 1 << 14
+    slots = rng.integers(0, S, (128, 9)).astype(np.int32)
+    mask = (rng.random((128, 9)) < 0.7).astype(np.float32)
+    fields = rng.integers(0, 6, (128, 9)).astype(np.int32)
+    a = plan_sorted_batch(slots, mask, S, fields=fields)
+    b = plan_sorted_batch(slots, mask, S, fields=fields, wire=True)
+    if b.sorted_row.dtype == np.int32:
+        pytest.skip("native planner unavailable: wire fell back to int32")
+    assert b.sorted_mask.dtype == np.uint8 and b.sorted_fields.dtype == np.uint8
+    np.testing.assert_array_equal(a.sorted_slots, b.sorted_slots)
+    np.testing.assert_array_equal(a.sorted_row, b.sorted_row.astype(np.int32))
+    np.testing.assert_array_equal(a.sorted_mask != 0, b.sorted_mask != 0)
+    np.testing.assert_array_equal(a.sorted_fields, b.sorted_fields.astype(np.int32))
+    np.testing.assert_array_equal(a.win_off, b.win_off)
+    bad_mask = mask.copy()
+    bad_mask[0, 0] = 0.5
+    with pytest.raises(ValueError, match="wire contract"):
+        plan_sorted_batch(slots, bad_mask, S, fields=fields, wire=True)

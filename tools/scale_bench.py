@@ -92,7 +92,54 @@ def ensure_data(args) -> dict:
     return meta
 
 
-def run_model(name: str, args) -> dict:
+def ensure_ffm_data(args) -> dict:
+    """FFM-truth companion dataset (VERDICT r4 item 7): same 10M-row
+    Zipf shape but labels from the planted field-PAIR interaction
+    concept (`truth="ffm"`, data/synth.py) — the scale anchor for the
+    model family the linear truth cannot exercise. 2^22 slots, not the
+    main run's 2^24: FFM's fused [S, 1+nf·k] FTRL state at 2^24 is
+    29 GB (bench.py ffm_s24_note), and the anchor's job is an AUC
+    regression line, which collisions at 3.6M ids → 2^22 still leave
+    meaningful."""
+    from xflow_tpu.data.synth import generate_shards_bulk
+
+    ddir = args.ffm_data_dir
+    os.makedirs(ddir, exist_ok=True)
+    meta_path = os.path.join(ddir, "meta.json")
+    want = {
+        "rows": args.rows,
+        "test_rows": args.test_rows,
+        "fields": args.fields,
+        "ids_per_field": args.ffm_ids_per_field,
+        "zipf_alpha": args.zipf_alpha,
+        "truth": "ffm",
+    }
+    if os.path.exists(meta_path) and not args.force_gen:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if all(meta.get(k) == v for k, v in want.items()):
+            print(f"# reusing ffm dataset in {ddir}", file=sys.stderr)
+            return meta
+    t0 = time.perf_counter()
+    generate_shards_bulk(
+        os.path.join(ddir, "train"), 1, args.rows, num_fields=args.fields,
+        ids_per_field=args.ffm_ids_per_field, seed=1, truth_seed=7,
+        zipf_alpha=args.zipf_alpha, truth="ffm",
+    )
+    generate_shards_bulk(
+        os.path.join(ddir, "test"), 1, args.test_rows, num_fields=args.fields,
+        ids_per_field=args.ffm_ids_per_field, seed=2, truth_seed=7,
+        zipf_alpha=args.zipf_alpha, truth="ffm",
+    )
+    meta = {**want, "gen_seconds": round(time.perf_counter() - t0, 1)}
+    with open(meta_path, "w") as f:
+        json.dump(meta, f, indent=1)
+    print(f"# generated ffm dataset: {json.dumps(meta)}", file=sys.stderr)
+    return meta
+
+
+def run_model(name: str, args, data_dir=None, log2_slots=None,
+              extra_cfg=None) -> dict:
     from xflow_tpu.config import Config, override
     from xflow_tpu.train.trainer import Trainer
 
@@ -100,15 +147,16 @@ def run_model(name: str, args) -> dict:
         Config(),
         **{
             "model.name": name,
-            "data.train_path": os.path.join(args.data_dir, "train"),
-            "data.test_path": os.path.join(args.data_dir, "test"),
+            "data.train_path": os.path.join(data_dir or args.data_dir, "train"),
+            "data.test_path": os.path.join(data_dir or args.data_dir, "test"),
             "data.batch_size": args.batch,
             "data.max_nnz": args.fields,
-            "data.log2_slots": args.log2_slots,
+            "data.log2_slots": log2_slots or args.log2_slots,
             "model.num_fields": args.fields,
             "train.epochs": args.epochs,
             "train.pred_dump": False,
             "train.log_every": 0,
+            **(extra_cfg or {}),
             # plain-product MVM's exact gradients vanish multiplicatively
             # at 18 all-present fields with the 1e-2 reference init
             # (tests/test_mvm_product.py::test_plus_one_learns_...), so
@@ -154,15 +202,26 @@ def main() -> int:
     ap.add_argument("--log2-slots", type=int, default=24)
     ap.add_argument("--batch", type=int, default=65536)
     ap.add_argument("--epochs", type=int, default=2)
-    ap.add_argument("--models", default="lr,fm,mvm")
+    ap.add_argument("--models", default="lr,fm,mvm,ffm")
     ap.add_argument("--mvm-plus-one", action=argparse.BooleanOptionalAction,
                     default=True)
     ap.add_argument("--data-dir", default=os.path.join(REPO, "scale_data"))
+    ap.add_argument("--ffm-data-dir",
+                    default=os.path.join(REPO, "scale_data_ffm"))
+    ap.add_argument("--ffm-ids-per-field", type=int, default=200_000)
+    ap.add_argument("--ffm-log2-slots", type=int, default=22)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SCALE.json"))
     ap.add_argument("--force-gen", action="store_true")
     args = ap.parse_args()
 
-    meta = ensure_data(args)
+    models = args.models.split(",")
+    # the linear-truth dataset feeds lr/fm/mvm only; an ffm-only run
+    # must not spend minutes generating 12M rows it never reads
+    meta = (
+        ensure_data(args)
+        if any(m != "ffm" for m in models)
+        else {"note": "linear dataset not touched (ffm-only run)"}
+    )
     import jax
 
     record = {
@@ -173,8 +232,41 @@ def main() -> int:
         "epochs": args.epochs,
         "models": {},
     }
-    for name in args.models.split(","):
+    if os.path.exists(args.out):
+        # partial runs (--models subset) MERGE into the committed record
+        # instead of silently dropping the other models' anchors
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+            record["models"].update(prev.get("models", {}))
+            if "ffm_dataset" in prev:
+                record["ffm_dataset"] = prev["ffm_dataset"]
+            if "note" in record["dataset"] and "dataset" in prev:
+                # ffm-only run: keep the committed linear-dataset meta
+                record["dataset"] = prev["dataset"]
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"# ignoring unreadable {args.out}: {e}", file=sys.stderr)
+    for name in models:
+        if name == "ffm":
+            continue  # its own dataset/truth below
         record["models"][name] = run_model(name, args)
+    if "ffm" in models:
+        # FFM anchors on its OWN dataset (planted field-pair truth) at
+        # 2^22 slots, with an FM companion on the SAME data so the
+        # "FFM beats a field-blind FM on this concept" gate
+        # (tests/test_ffm.py) has a scale-sized counterpart
+        ffm_meta = ensure_ffm_data(args)
+        record["ffm_dataset"] = ffm_meta
+        ffm_over = {"model.v_dim": 4}
+        record["models"]["ffm"] = run_model(
+            "ffm", args, data_dir=args.ffm_data_dir,
+            log2_slots=args.ffm_log2_slots, extra_cfg=ffm_over,
+        )
+        record["models"]["fm_on_ffm_truth"] = run_model(
+            "fm", args, data_dir=args.ffm_data_dir,
+            log2_slots=args.ffm_log2_slots,
+            extra_cfg={"model.v_dim": 16},
+        )
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps({"metric": "scale_bench", "out": args.out,

@@ -123,6 +123,22 @@ def get_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32),
         ]
+        lib.xf_plan_sorted_wire.restype = ctypes.c_long
+        lib.xf_plan_sorted_wire.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint16),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _LIB = lib
     return _LIB
 
@@ -174,6 +190,63 @@ def native_plan_sorted(slots, mask, fields, num_slots: int, window: int, np_len:
     )
     if rc != 0:
         raise ValueError(f"xf_plan_sorted failed (rc={rc})")
+    return out_slots, out_row, out_mask, out_fields, win_off
+
+
+def native_plan_sorted_wire(slots, mask, fields, num_slots: int, window: int,
+                            np_len: int):
+    """C radix-sort plan builder emitting WIRE dtypes directly
+    (xf_plan_sorted_wire): uint16 rows, uint8 mask/fields — the
+    compact_plan_wire numpy passes never run. Callers must have
+    checked the CONFIG bounds (rows ≤ 2^16, fields < 2^8); rc=-2
+    means a bound or the 0/1-mask contract was violated by the data —
+    a pipeline bug, raised loudly."""
+    lib = get_lib()
+    slots = np.ascontiguousarray(slots, np.int32)
+    mask_flat = np.ascontiguousarray(mask, np.float32).ravel()
+    B, F = slots.shape
+    n = B * F
+    if mask_flat.size != n:
+        raise ValueError(f"mask size {mask_flat.size} != slots size {n}")
+    if fields is not None and np.asarray(fields).size != n:
+        raise ValueError(f"fields size {np.asarray(fields).size} != slots size {n}")
+    out_slots = np.empty(np_len, np.int32)
+    out_row = np.empty(np_len, np.uint16)
+    out_mask = np.empty(np_len, np.uint8)
+    out_fields = np.empty(np_len, np.uint8) if fields is not None else None
+    win_off = np.empty(num_slots // window + 1, np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    fields_c = (
+        np.ascontiguousarray(fields, np.int32).ctypes.data_as(i32p)
+        if fields is not None
+        else None
+    )
+    rc = lib.xf_plan_sorted_wire(
+        slots.ctypes.data_as(i32p),
+        mask_flat.ctypes.data_as(f32p),
+        fields_c,
+        n,
+        F,
+        num_slots,
+        window,
+        np_len,
+        out_slots.ctypes.data_as(i32p),
+        out_row.ctypes.data_as(u16p),
+        out_mask.ctypes.data_as(u8p),
+        out_fields.ctypes.data_as(u8p) if out_fields is not None else None,
+        win_off.ctypes.data_as(i32p),
+    )
+    if rc == -2:
+        raise ValueError(
+            "xf_plan_sorted_wire: data violated the wire contract "
+            "(row ≥ 2^16, field ≥ 2^8, or a non-0/1 mask) — the caller's "
+            "config-derived bounds disagree with the batch"
+        )
+    if rc != 0:
+        raise ValueError(f"xf_plan_sorted_wire failed (rc={rc})")
     return out_slots, out_row, out_mask, out_fields, win_off
 
 

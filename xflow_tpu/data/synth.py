@@ -144,6 +144,7 @@ def generate_shards_bulk(
     zipf_alpha: float = 0.0,
     chunk_rows: int = 200_000,
     track_seen: bool = False,
+    truth: str = "linear",
 ):
     """Chunked vectorized writer for realistic-scale datasets (≥10M rows,
     BASELINE.md configs 2-3): same planted-truth model as
@@ -161,7 +162,16 @@ def generate_shards_bulk(
     """
     rng = np.random.default_rng(seed)
     truth_rng = np.random.default_rng(seed if truth_seed is None else truth_seed)
-    truth = _planted_truth(truth_rng, num_fields, ids_per_field, truth_density)
+    if truth not in ("linear", "ffm"):
+        raise ValueError(f"truth={truth!r}: expected linear|ffm")
+    ffm_truth = truth == "ffm"
+    if ffm_truth:
+        # same planted concept as generate_shards' truth="ffm" (field-
+        # pair interactions a field-blind FM cannot fit); scored per
+        # CHUNK through one gram einsum instead of per row
+        u, s_pairs, scale = _planted_ffm_truth(truth_rng, num_fields, ids_per_field)
+    else:
+        w_truth = _planted_truth(truth_rng, num_fields, ids_per_field, truth_density)
     value_suffix = ":%.4f" % (1.0 / np.sqrt(num_fields))
     zipf_cdf = _zipf_cdf(ids_per_field, zipf_alpha)
     seen = (
@@ -189,7 +199,12 @@ def generate_shards_bulk(
                     ).astype(np.int64)
                 else:
                     ids = rng.integers(0, ids_per_field, size=(c, num_fields))
-                logit = truth[np.arange(num_fields)[None, :], ids].sum(axis=1)
+                if ffm_truth:
+                    ur = u[np.arange(num_fields)[None, :], ids]  # [c, nf, d]
+                    gram = np.einsum("cad,cbd->cab", ur, ur)
+                    logit = scale * (gram * s_pairs[None]).sum(axis=(1, 2))
+                else:
+                    logit = w_truth[np.arange(num_fields)[None, :], ids].sum(axis=1)
                 logit = logit + rng.normal(0.0, noise, size=c)
                 labels = (logit > 0).astype(np.int64)
                 gids = ids + offsets

@@ -555,34 +555,37 @@ long xf_count_rows(const char* path, long block_bytes) {
 //     w in [0, num_slots/window]; pads are owned by the last window
 //   - stability: equal slots keep original (row-major) occurrence order
 
-extern "C" {
+namespace {
 
-long xf_plan_sorted(const int32_t* slots, const float* mask, const int32_t* fields,
-                    long n, long nnz_per_row, long num_slots, long window,
-                    long np_len, int32_t* out_slots, int32_t* out_row,
-                    float* out_mask, int32_t* out_fields, int32_t* out_win_off) {
-  if (n < 0 || np_len < n || nnz_per_row <= 0 || num_slots <= 0 || window <= 0 ||
-      num_slots % window != 0) {
-    return -1;
+// PAIR-ENCODED LSD radix (docs/PERF.md host-plane lever): each element
+// is one uint64 (slot << 32 | original index), sorted by the slot
+// digits only. The index-array variant did an indirect slots[cur[i]]
+// load per element per pass — a cache-hostile random read through the
+// permutation; here every pass streams the key array sequentially.
+// Stability: LSD passes are stable and the index rides in the low
+// bits, so equal slots keep their original order — bit-identical
+// output to the numpy argsort(kind='stable') planner (parity-tested).
+// Returns the sorted key pointer (into keys or scratch), or nullptr on
+// invalid input — validation lives here so both emitters share it.
+uint64_t* plan_sort_core(const int32_t* slots, long n, long nnz_per_row,
+                         long num_slots, long window, long np_len,
+                         std::vector<uint64_t>& keys,
+                         std::vector<uint64_t>& scratch) {
+  if (n < 0 || np_len < n || nnz_per_row <= 0 || num_slots <= 0 ||
+      window <= 0 || num_slots % window != 0) {
+    return nullptr;
   }
   // validate slot range up front: the radix sort masks each 11-bit digit,
   // so an out-of-range slot would otherwise be silently aliased into a
   // wrong window (and its gradient scattered to a wrong table row) —
   // loud failure matches this function's convention (advisor r2)
   for (long i = 0; i < n; ++i) {
-    if (slots[i] < 0 || slots[i] >= num_slots) return -1;
+    if (slots[i] < 0 || slots[i] >= num_slots) return nullptr;
   }
-  // PAIR-ENCODED LSD radix (docs/PERF.md host-plane lever): each element
-  // is one uint64 (slot << 32 | original index), sorted by the slot
-  // digits only. The index-array variant did an indirect slots[cur[i]]
-  // load per element per pass — a cache-hostile random read through the
-  // permutation; here every pass streams the key array sequentially.
-  // Stability: LSD passes are stable and the index rides in the low
-  // bits, so equal slots keep their original order — bit-identical
-  // output to the numpy argsort(kind='stable') planner (parity-tested).
   constexpr int kDigitBits = 11;
   constexpr int kRadix = 1 << kDigitBits;
-  std::vector<uint64_t> keys(n), scratch(n);
+  keys.resize(n);
+  scratch.resize(n);
   for (long i = 0; i < n; ++i) {
     keys[i] = (static_cast<uint64_t>(static_cast<uint32_t>(slots[i])) << 32) |
               static_cast<uint32_t>(i);
@@ -611,6 +614,34 @@ long xf_plan_sorted(const int32_t* slots, const float* mask, const int32_t* fiel
     cur = nxt;
     nxt = t;
   }
+  return cur;
+}
+
+void plan_win_off(const int32_t* out_slots, long np_len, long num_slots,
+                  long window, int32_t* out_win_off) {
+  // win_off by linear scan over the sorted (padded) slots
+  long n_win = num_slots / window;
+  long pos = 0;
+  out_win_off[0] = 0;
+  for (long w = 1; w <= n_win; ++w) {
+    long bound = w * window;
+    while (pos < np_len && out_slots[pos] < bound) ++pos;
+    out_win_off[w] = static_cast<int32_t>(pos);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+long xf_plan_sorted(const int32_t* slots, const float* mask, const int32_t* fields,
+                    long n, long nnz_per_row, long num_slots, long window,
+                    long np_len, int32_t* out_slots, int32_t* out_row,
+                    float* out_mask, int32_t* out_fields, int32_t* out_win_off) {
+  std::vector<uint64_t> keys, scratch;
+  uint64_t* cur =
+      plan_sort_core(slots, n, nnz_per_row, num_slots, window, np_len, keys, scratch);
+  if (cur == nullptr) return -1;
   for (long i = 0; i < n; ++i) {
     uint64_t k = cur[i];
     int32_t src = static_cast<int32_t>(k & 0xffffffffu);
@@ -625,15 +656,50 @@ long xf_plan_sorted(const int32_t* slots, const float* mask, const int32_t* fiel
     out_mask[i] = 0.0f;
     if (out_fields != nullptr) out_fields[i] = 0;
   }
-  // win_off by linear scan over the sorted (padded) slots
-  long n_win = num_slots / window;
-  long pos = 0;
-  out_win_off[0] = 0;
-  for (long w = 1; w <= n_win; ++w) {
-    long bound = w * window;
-    while (pos < np_len && out_slots[pos] < bound) ++pos;
-    out_win_off[w] = static_cast<int32_t>(pos);
+  plan_win_off(out_slots, np_len, num_slots, window, out_win_off);
+  return 0;
+}
+
+// Wire-format emitter (ops/sorted_table.compact_plan_wire's dtypes
+// produced DIRECTLY): uint16 row ids, uint8 0/1 mask, uint8 fields —
+// the numpy intermediate plus three astype passes per batch disappear
+// from the host budget. The caller guarantees the bounds from CONFIG
+// (rows <= 2^16, fields < 2^8 — never from data, the multi-process
+// rank-symmetry rule); a violated bound or a non-0/1 mask returns -2
+// (distinct from -1 = malformed plan input) so the Python wrapper can
+// name the actual contract broken.
+long xf_plan_sorted_wire(const int32_t* slots, const float* mask,
+                         const int32_t* fields, long n, long nnz_per_row,
+                         long num_slots, long window, long np_len,
+                         int32_t* out_slots, uint16_t* out_row,
+                         uint8_t* out_mask, uint8_t* out_fields,
+                         int32_t* out_win_off) {
+  std::vector<uint64_t> keys, scratch;
+  uint64_t* cur =
+      plan_sort_core(slots, n, nnz_per_row, num_slots, window, np_len, keys, scratch);
+  if (cur == nullptr) return -1;
+  for (long i = 0; i < n; ++i) {
+    uint64_t k = cur[i];
+    int32_t src = static_cast<int32_t>(k & 0xffffffffu);
+    long row = src / nnz_per_row;
+    float m = mask[src];
+    if (row >= (1L << 16) || (m != 0.0f && m != 1.0f)) return -2;
+    out_slots[i] = static_cast<int32_t>(k >> 32);
+    out_row[i] = static_cast<uint16_t>(row);
+    out_mask[i] = static_cast<uint8_t>(m != 0.0f);
+    if (out_fields != nullptr) {
+      int32_t f = fields[src];
+      if (f < 0 || f >= (1 << 8)) return -2;
+      out_fields[i] = static_cast<uint8_t>(f);
+    }
   }
+  for (long i = n; i < np_len; ++i) {
+    out_slots[i] = static_cast<int32_t>(num_slots - 1);
+    out_row[i] = 0;
+    out_mask[i] = 0;
+    if (out_fields != nullptr) out_fields[i] = 0;
+  }
+  plan_win_off(out_slots, np_len, num_slots, window, out_win_off);
   return 0;
 }
 

@@ -296,6 +296,7 @@ def plan_sorted_batch(
     mask: np.ndarray,
     num_slots: int,
     fields: Optional[np.ndarray] = None,
+    wire: bool = False,
 ) -> SortedPlan:
     """Sort a [B, F] batch's occurrences by table slot (host side).
 
@@ -303,7 +304,15 @@ def plan_sorted_batch(
     along and zeroes both the forward contribution and the gradient.
     `fields` (MVM) rides through the same permutation when given.
     Uses the C radix-sort builder when built (bit-identical to the numpy
-    path — both sorts are stable; parity-tested).
+    path — both sorts are stable; parity-tested). `wire=True` asks the
+    C builder to emit the compact wire dtypes (uint16 rows, uint8
+    mask/fields — compact_plan_wire's format) DIRECTLY, skipping the
+    int32/f32 intermediate and its astype passes; the caller must have
+    checked the CONFIG bounds (rows per sub-batch ≤ 2^16, fields <
+    2^8) — compact_plan_wire stays the single place those rules live,
+    and it passes already-compact arrays through untouched. Without the
+    native builder `wire` is ignored (the numpy path emits int32 and
+    compaction happens downstream as before).
     """
     native = _native_planner()
     if native and num_slots % WINDOW == 0:
@@ -311,6 +320,15 @@ def plan_sorted_batch(
         # (handled once at load in _native_planner); a runtime failure in a
         # successfully-built planner is a bug and must raise, not silently
         # re-run the 4x-slower argsort on every batch
+        if wire:
+            from xflow_tpu.data.native import native_plan_sorted_wire
+
+            ss, row, m, f, off = native_plan_sorted_wire(
+                np.ascontiguousarray(slots, np.int32),
+                mask, fields, num_slots, WINDOW,
+                padded_len(slots.size),
+            )
+            return SortedPlan(ss, row, m, off, f)
         ss, row, m, f, off = native(
             np.ascontiguousarray(slots, np.int32),
             mask, fields, num_slots, WINDOW,
@@ -369,6 +387,7 @@ def plan_sorted_stacked(
     fields: Optional[np.ndarray] = None,
     num_sub: int = 1,
     always_stack: bool = False,
+    wire: bool = False,
 ) -> SortedPlan:
     """Per-sub-batch sorted plans, stacked on a leading [NS] axis.
 
@@ -383,7 +402,7 @@ def plan_sorted_stacked(
     """
     B = slots.shape[0]
     if num_sub <= 1:
-        p = plan_sorted_batch(slots, mask, num_slots, fields=fields)
+        p = plan_sorted_batch(slots, mask, num_slots, fields=fields, wire=wire)
         if not always_stack:
             return p
         return SortedPlan(
@@ -403,6 +422,7 @@ def plan_sorted_stacked(
             mask[i * bs : (i + 1) * bs],
             num_slots,
             fields=None if fields is None else fields[i * bs : (i + 1) * bs],
+            wire=wire,
         )
 
     if num_slots % WINDOW == 0:

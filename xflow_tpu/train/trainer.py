@@ -437,6 +437,7 @@ class Trainer:
             want_fields = self.cfg.model.name == "ffm" or (
                 self.cfg.model.name == "mvm" and self._mvm_wants_fields(batch)[0]
             )
+            rows_bound = self.cfg.data.batch_size // max(self._sorted_sub, 1)
             plan = plan_sorted_stacked(
                 np.asarray(batch.slots),
                 np.asarray(batch.mask),
@@ -445,6 +446,12 @@ class Trainer:
                 num_sub=self._sorted_sub,
                 # the sharded engine wants a leading [D] axis even at D=1
                 always_stack=self._sorted_sharded,
+                # CONFIG-derived (rank-symmetric) wire decision, the same
+                # rule compact_plan_wire applies — the C planner then
+                # emits uint16/uint8 directly and the compaction below
+                # passes the arrays through untouched
+                wire=rows_bound <= (1 << 16)
+                and (not want_fields or self.cfg.model.num_fields <= (1 << 8)),
             )
             arrays.update(
                 sorted_slots=plan.sorted_slots,
