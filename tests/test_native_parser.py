@@ -279,3 +279,21 @@ def test_plan_sorted_wire_parity():
     bad_mask[0, 0] = 0.5
     with pytest.raises(ValueError, match="wire contract"):
         plan_sorted_batch(slots, bad_mask, S, fields=fields, wire=True)
+
+
+def test_plan_sorted_empty_batch_matches_numpy():
+    """A zero-row batch plans to the all-pad plan on BOTH planners (the
+    round-5 plan_sort_core refactor briefly made the native one return
+    rc=-1 because vector::data() on an empty vector is nullptr — its
+    error sentinel)."""
+    import numpy as np
+
+    from xflow_tpu.ops.sorted_table import plan_sorted_batch
+
+    S = 1 << 14
+    empty = np.zeros((0, 5), np.int32)
+    emptym = np.zeros((0, 5), np.float32)
+    a = plan_sorted_batch(empty, emptym, S)
+    assert (np.asarray(a.sorted_mask) == 0).all()
+    assert (np.asarray(a.sorted_slots) == S - 1).all()
+    assert a.win_off[-1] == a.sorted_slots.shape[0]

@@ -175,6 +175,7 @@ def run_model(name: str, args, data_dir=None, log2_slots=None,
         "train_seconds": round(res.seconds, 1),
         "steps": res.steps,
         "epochs": res.epochs,
+        "batch_size": args.batch,
         "examples": res.examples,
         "last_loss": round(res.last_loss, 6),
         "test_auc": round(auc, 6),
@@ -224,12 +225,13 @@ def main() -> int:
     )
     import jax
 
+    # epochs/batch live PER MODEL record: partial runs (--models subset)
+    # merge into the committed file, and a top-level stamp would
+    # misattribute the merged entries' provenance
     record = {
         "dataset": meta,
         "device": str(jax.devices()[0]),
         "host_cores": os.cpu_count(),
-        "batch_size": args.batch,
-        "epochs": args.epochs,
         "models": {},
     }
     if os.path.exists(args.out):
@@ -257,15 +259,30 @@ def main() -> int:
         # (tests/test_ffm.py) has a scale-sized counterpart
         ffm_meta = ensure_ffm_data(args)
         record["ffm_dataset"] = ffm_meta
-        ffm_over = {"model.v_dim": 4}
+        # SGD with a real v init, like the unit gate
+        # (tests/test_ffm.py::test_ffm_beats_fm_...): under the
+        # reference-default zero-init FTRL, interaction gradients
+        # (∝ the opposing vectors = 0) never bootstrap and BOTH models
+        # collapse to the identical pure-LR predictor — measured here
+        # before this override existed: ffm and fm both landed at AUC
+        # 0.541991 bitwise-equal.
+        # lr and init are scale-tuned, NOT the unit gate's (256-row
+        # batches, nf=4, lr 0.5, v_init 0.1): at nf=18 a constant
+        # v_init of 0.1 puts the initial pairwise term at
+        # ~0.5*nf^2*k*v^2 = +6.1 — every sigmoid saturated from step 0
+        # (measured: loss climbs to ~1.0, AUC ~0.50 at both lr 0.5 and
+        # 0.1). v = 0.02 keeps the initial term ~0.25.
+        sgd = {"optim.name": "sgd", "optim.sgd.lr": 0.1,
+               "optim.v_init_sgd": 0.02}
         record["models"]["ffm"] = run_model(
             "ffm", args, data_dir=args.ffm_data_dir,
-            log2_slots=args.ffm_log2_slots, extra_cfg=ffm_over,
+            log2_slots=args.ffm_log2_slots,
+            extra_cfg={"model.v_dim": 4, **sgd},
         )
         record["models"]["fm_on_ffm_truth"] = run_model(
             "fm", args, data_dir=args.ffm_data_dir,
             log2_slots=args.ffm_log2_slots,
-            extra_cfg={"model.v_dim": 16},
+            extra_cfg={"model.v_dim": 16, **sgd},
         )
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)

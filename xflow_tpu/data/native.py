@@ -143,12 +143,11 @@ def get_lib() -> ctypes.CDLL:
     return _LIB
 
 
-def native_plan_sorted(slots, mask, fields, num_slots: int, window: int, np_len: int):
-    """C radix-sort plan builder (xf_plan_sorted). Returns the plan
-    arrays (sorted_slots, sorted_row, sorted_mask, sorted_fields|None,
-    win_off) or raises on toolchain/library failure. ctypes releases the
-    GIL during the call, so stacked sub-batch plans can run in parallel
-    host threads."""
+def _plan_sorted_call(slots, mask, fields, num_slots: int, window: int,
+                      np_len: int, wire: bool):
+    """Shared marshalling for the two C plan emitters — ONE place for
+    the size validation and pointer plumbing; only the output dtypes
+    and entry point differ."""
     lib = get_lib()
     slots = np.ascontiguousarray(slots, np.int32)
     mask_flat = np.ascontiguousarray(mask, np.float32).ravel()
@@ -160,20 +159,26 @@ def native_plan_sorted(slots, mask, fields, num_slots: int, window: int, np_len:
         raise ValueError(f"mask size {mask_flat.size} != slots size {n}")
     if fields is not None and np.asarray(fields).size != n:
         raise ValueError(f"fields size {np.asarray(fields).size} != slots size {n}")
+    row_dt, mask_dt, f_dt = (
+        (np.uint16, np.uint8, np.uint8) if wire else (np.int32, np.float32, np.int32)
+    )
     out_slots = np.empty(np_len, np.int32)
-    out_row = np.empty(np_len, np.int32)
-    out_mask = np.empty(np_len, np.float32)
-    out_fields = np.empty(np_len, np.int32) if fields is not None else None
-    n_win = num_slots // window
-    win_off = np.empty(n_win + 1, np.int32)
+    out_row = np.empty(np_len, row_dt)
+    out_mask = np.empty(np_len, mask_dt)
+    out_fields = np.empty(np_len, f_dt) if fields is not None else None
+    win_off = np.empty(num_slots // window + 1, np.int32)
     i32p = ctypes.POINTER(ctypes.c_int32)
     f32p = ctypes.POINTER(ctypes.c_float)
+    rowp = ctypes.POINTER(ctypes.c_uint16 if wire else ctypes.c_int32)
+    maskp = ctypes.POINTER(ctypes.c_uint8 if wire else ctypes.c_float)
+    fp = ctypes.POINTER(ctypes.c_uint8 if wire else ctypes.c_int32)
     fields_c = (
         np.ascontiguousarray(fields, np.int32).ctypes.data_as(i32p)
         if fields is not None
         else None
     )
-    rc = lib.xf_plan_sorted(
+    fn = lib.xf_plan_sorted_wire if wire else lib.xf_plan_sorted
+    rc = fn(
         slots.ctypes.data_as(i32p),
         mask_flat.ctypes.data_as(f32p),
         fields_c,
@@ -183,14 +188,33 @@ def native_plan_sorted(slots, mask, fields, num_slots: int, window: int, np_len:
         window,
         np_len,
         out_slots.ctypes.data_as(i32p),
-        out_row.ctypes.data_as(i32p),
-        out_mask.ctypes.data_as(f32p),
-        out_fields.ctypes.data_as(i32p) if out_fields is not None else None,
+        out_row.ctypes.data_as(rowp),
+        out_mask.ctypes.data_as(maskp),
+        out_fields.ctypes.data_as(fp) if out_fields is not None else None,
         win_off.ctypes.data_as(i32p),
     )
+    if rc == -2:
+        raise ValueError(
+            "xf_plan_sorted_wire: data violated the wire contract "
+            "(row ≥ 2^16, field ≥ 2^8, or a non-0/1 mask) — the caller's "
+            "config-derived bounds disagree with the batch"
+        )
     if rc != 0:
-        raise ValueError(f"xf_plan_sorted failed (rc={rc})")
+        raise ValueError(
+            f"{'xf_plan_sorted_wire' if wire else 'xf_plan_sorted'} "
+            f"failed (rc={rc})"
+        )
     return out_slots, out_row, out_mask, out_fields, win_off
+
+
+def native_plan_sorted(slots, mask, fields, num_slots: int, window: int, np_len: int):
+    """C radix-sort plan builder (xf_plan_sorted). Returns the plan
+    arrays (sorted_slots, sorted_row, sorted_mask, sorted_fields|None,
+    win_off) or raises on toolchain/library failure. ctypes releases the
+    GIL during the call, so stacked sub-batch plans can run in parallel
+    host threads."""
+    return _plan_sorted_call(slots, mask, fields, num_slots, window, np_len,
+                             wire=False)
 
 
 def native_plan_sorted_wire(slots, mask, fields, num_slots: int, window: int,
@@ -201,53 +225,8 @@ def native_plan_sorted_wire(slots, mask, fields, num_slots: int, window: int,
     checked the CONFIG bounds (rows ≤ 2^16, fields < 2^8); rc=-2
     means a bound or the 0/1-mask contract was violated by the data —
     a pipeline bug, raised loudly."""
-    lib = get_lib()
-    slots = np.ascontiguousarray(slots, np.int32)
-    mask_flat = np.ascontiguousarray(mask, np.float32).ravel()
-    B, F = slots.shape
-    n = B * F
-    if mask_flat.size != n:
-        raise ValueError(f"mask size {mask_flat.size} != slots size {n}")
-    if fields is not None and np.asarray(fields).size != n:
-        raise ValueError(f"fields size {np.asarray(fields).size} != slots size {n}")
-    out_slots = np.empty(np_len, np.int32)
-    out_row = np.empty(np_len, np.uint16)
-    out_mask = np.empty(np_len, np.uint8)
-    out_fields = np.empty(np_len, np.uint8) if fields is not None else None
-    win_off = np.empty(num_slots // window + 1, np.int32)
-    i32p = ctypes.POINTER(ctypes.c_int32)
-    f32p = ctypes.POINTER(ctypes.c_float)
-    u16p = ctypes.POINTER(ctypes.c_uint16)
-    u8p = ctypes.POINTER(ctypes.c_uint8)
-    fields_c = (
-        np.ascontiguousarray(fields, np.int32).ctypes.data_as(i32p)
-        if fields is not None
-        else None
-    )
-    rc = lib.xf_plan_sorted_wire(
-        slots.ctypes.data_as(i32p),
-        mask_flat.ctypes.data_as(f32p),
-        fields_c,
-        n,
-        F,
-        num_slots,
-        window,
-        np_len,
-        out_slots.ctypes.data_as(i32p),
-        out_row.ctypes.data_as(u16p),
-        out_mask.ctypes.data_as(u8p),
-        out_fields.ctypes.data_as(u8p) if out_fields is not None else None,
-        win_off.ctypes.data_as(i32p),
-    )
-    if rc == -2:
-        raise ValueError(
-            "xf_plan_sorted_wire: data violated the wire contract "
-            "(row ≥ 2^16, field ≥ 2^8, or a non-0/1 mask) — the caller's "
-            "config-derived bounds disagree with the batch"
-        )
-    if rc != 0:
-        raise ValueError(f"xf_plan_sorted_wire failed (rc={rc})")
-    return out_slots, out_row, out_mask, out_fields, win_off
+    return _plan_sorted_call(slots, mask, fields, num_slots, window, np_len,
+                             wire=True)
 
 
 def native_count_rows(path: str, block_bytes: int) -> int:

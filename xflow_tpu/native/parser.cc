@@ -582,6 +582,14 @@ uint64_t* plan_sort_core(const int32_t* slots, long n, long nnz_per_row,
   for (long i = 0; i < n; ++i) {
     if (slots[i] < 0 || slots[i] >= num_slots) return nullptr;
   }
+  if (n == 0) {
+    // nullptr is this function's ERROR sentinel, and vector::data() on
+    // an empty vector may legally return nullptr — hand back a valid
+    // pointer the (empty) emission loop never dereferences, so a
+    // zero-row batch produces an all-pad plan like the numpy path
+    keys.resize(1);
+    return keys.data();
+  }
   constexpr int kDigitBits = 11;
   constexpr int kRadix = 1 << kDigitBits;
   keys.resize(n);
