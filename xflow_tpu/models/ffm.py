@@ -38,8 +38,9 @@ Path choice (round 5, measured — docs/PERF.md): on ONE device
 `sorted_layout=auto` (and `on`) runs the ALIGNED HYBRID sorted engine
 (`make_ffm_aligned_op` below): windowed table gather + host placement
 permutation + layout-friendly MXU row side + fused scatter+FTRL —
-512k ex/s at B = 64k, 2^22 slots (565k with `data.sorted_bf16`),
-vs 193k for the round-4 row-major einsum path at its 16k cap.
+623k ex/s at B = 64k / 742k at the 128k practical batch (843k with
+`data.sorted_bf16`), 2^22 slots, vs 193k for the round-4 row-major
+einsum path at its 16k cap.
 Batches with duplicate (row, field) occurrences fall back per batch
 to the row-major einsum path in `forward` (the general form, itself
 layout-rewritten this round: 282k at 16k where round 4's 4-D einsum
@@ -344,6 +345,12 @@ def make_ffm_aligned_op(nf: int, k: int, k8: int, rows: int):
     nfp = nf_padded(nf)
 
     def rowmath(A, T, Q, W):
+        # HIGHEST is the measured optimum here: a 3-pass bf16 selector
+        # split (the gather kernels' _dot_f32 trick — T is 0/1 and each
+        # output selects one A element, so it would be exact) benched
+        # SLOWER (195 vs 177 ms/step at B=128k) — the hi/mid/lo split's
+        # extra elementwise passes over [B, nfp, k8] cost more than the
+        # MXU passes they save on this skinny contraction
         X = jnp.einsum(
             "bce,cedf->bdf", A, T, precision=jax.lax.Precision.HIGHEST
         )
