@@ -20,4 +20,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count override as a config option; older
+    # versions only honor the XLA_FLAGS form already exported above
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass

@@ -1,0 +1,110 @@
+"""Malformed-input parity: the row counters and the parsers must agree.
+
+Multi-process step coordination rests on one invariant: for ANY input —
+junk labels, feature-less lines, separator-free lines, truncated final
+lines — `count_rows` (Python predicate) and `native_count_rows` (C
+predicate) report the same number, and the matching parser yields
+exactly that many rows (so `count_batches` predicts the batch stream
+exactly). The trainer's `_coordinated_batches` drift check fires at run
+time on any mismatch; these tests pin the predicates directly,
+property-style over seeded random junk compositions
+(xflow_tpu.testing.faults.write_malformed_libffm) plus hand-picked edge
+files.
+"""
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.data.libffm import count_rows, iter_examples
+from xflow_tpu.data.pipeline import batch_iterator, count_batches
+from xflow_tpu.testing.faults import write_malformed_libffm
+
+
+def _data_cfg(**kw):
+    base = {
+        "data.batch_size": 8,
+        "data.max_nnz": 8,
+        "data.log2_slots": 10,
+        "data.max_bad_rows": -1,
+    }
+    base.update(kw)
+    return override(Config(), **base).data
+
+
+def _native_available() -> bool:
+    try:
+        from xflow_tpu.data.native import get_lib
+
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("truncated_tail", [False, True])
+def test_counters_match_parsers_on_junk(tmp_path, seed, truncated_tail):
+    """Property over random junk compositions: both counters equal the
+    planted row count, and both parser paths yield exactly the predicted
+    batches with exactly that many real rows."""
+    path = str(tmp_path / f"junk-{seed}")
+    info = write_malformed_libffm(
+        path, n_good=20 + seed * 3, n_bad=seed % 4, n_junk_label=seed % 3,
+        n_nonrows=5, seed=seed, truncated_tail=truncated_tail,
+    )
+    rows = info["rows"]
+    assert count_rows(path) == rows
+    assert len(list(iter_examples(path, 10))) == rows
+
+    cfg_py = _data_cfg(**{"data.use_native_parser": False})
+    expected_batches = -(-rows // cfg_py.batch_size) if rows else 0
+    assert count_batches(path, cfg_py) == expected_batches
+    got_py = list(batch_iterator(path, cfg_py))
+    assert len(got_py) == expected_batches
+    assert sum(int((np.asarray(b.row_mask) > 0).sum()) for b in got_py) == rows
+
+    if not _native_available():
+        pytest.skip("native toolchain unavailable")
+    from xflow_tpu.data.native import native_count_rows
+
+    assert native_count_rows(path, cfg_py.block_bytes) == rows
+    cfg_nat = _data_cfg(**{"data.use_native_parser": True})
+    got_nat = list(batch_iterator(path, cfg_nat))
+    assert len(got_nat) == expected_batches
+    assert sum(int((np.asarray(b.row_mask) > 0).sum()) for b in got_nat) == rows
+    # full batch parity, not just counts: identical labels/slots/masks
+    for bp, bn in zip(got_py, got_nat):
+        np.testing.assert_array_equal(bp.labels, bn.labels)
+        np.testing.assert_array_equal(bp.slots, bn.slots)
+        np.testing.assert_array_equal(bp.mask, bn.mask)
+        np.testing.assert_array_equal(bp.row_mask, bn.row_mask)
+
+
+EDGE_FILES = {
+    # label-only lines, trailing whitespace flavors, separator subtleties
+    "label_only": ("1\n0\n", 0),
+    "label_trailing_ws": ("1   \n0\t\n", 0),  # strip first; no separator left
+    "space_separator": ("1 0:5:1\n", 1),
+    "sep_only_junk": ("abc def\n", 1),  # junk label + junk token = a bad row
+    "crlf": ("1\t0:5:1\r\n0\t1:6:1\r\n", 2),
+    "empty": ("", 0),
+    "newlines_only": ("\n\n\n", 0),
+    "truncated_no_newline": ("1\t0:5:1", 1),
+    "truncated_mid_token": ("1\t0:5:1\n0\t3:77", 2),
+    "unicode_ws": ("1 label\n", 0),  # NBSP is NOT a separator (C parity)
+}
+
+
+@pytest.mark.parametrize("name", sorted(EDGE_FILES))
+def test_counter_parity_edge_files(tmp_path, name):
+    text, rows = EDGE_FILES[name]
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        f.write(text)
+    assert count_rows(path) == rows, name
+    assert len(list(iter_examples(path, 10))) == rows, name
+    if _native_available():
+        from xflow_tpu.data.native import native_count_rows
+
+        assert native_count_rows(path, 1 << 20) == rows, name

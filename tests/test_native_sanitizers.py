@@ -49,11 +49,13 @@ DRIVER = textwrap.dedent("""
     a = list(native_batch_iterator(path, seq, 64))
     b = list(native_batch_iterator(path, mt, 64))
     assert len(a) == len(b) and len(a) > 0, (len(a), len(b))
-    for x, y in zip(a, b):
-        np.testing.assert_array_equal(x.slots, y.slots)
-        np.testing.assert_array_equal(x.fields, y.fields)
-        np.testing.assert_array_equal(x.mask, y.mask)
-        np.testing.assert_array_equal(x.labels, y.labels)
+    for i, (x, y) in enumerate(zip(a, b)):
+        # plain elementwise compares, NOT np.testing: lazily importing
+        # numpy.testing inside a TSan-preloaded process deadlocks on
+        # some kernels (observed on 4.4 — zero CPU until timeout)
+        for field in ("slots", "fields", "mask", "labels"):
+            xa, ya = getattr(x, field), getattr(y, field)
+            assert (xa == ya).all(), (i, field)
     assert native_count_rows(path, 4096) == sum(
         int(x.row_mask.sum()) for x in a
     )
@@ -74,6 +76,17 @@ def test_mt_parser_under_sanitizer(tmp_path, sanitize):
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["XFLOW_NATIVE_SANITIZE"] = sanitize
     env["XFLOW_NATIVE_CACHE"] = str(tmp_path / "build")
+    # pre-build the sanitized .so WITHOUT the preload: the driver would
+    # otherwise spawn g++ with the sanitizer runtime LD_PRELOADed into
+    # it, which deadlocks outright on some kernels (observed on 4.4:
+    # zero CPU until the timeout). The cache key includes the sanitize
+    # flag, so the preloaded driver below picks this build up as-is.
+    build = subprocess.run(
+        [sys.executable, "-c",
+         "from xflow_tpu.data.native import _build_lib; print(_build_lib())"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert build.returncode == 0, f"sanitized build failed:\n{build.stderr}"
     env["LD_PRELOAD"] = runtime
     # leak checking would flag the PYTHON interpreter's own allocations;
     # the parser's handles are close()d explicitly, which IS exercised

@@ -103,8 +103,10 @@ def test_scatter_vjp_matches_xla_scatter():
 @pytest.mark.parametrize("seed", [3, 4])
 def test_pallas_interpret_matches_xla(seed):
     # the TPU kernels, run in interpreter mode, must equal the XLA path
-    from jax.experimental.pallas import tpu as pltpu
+    pltpu = pytest.importorskip("jax.experimental.pallas.tpu")
 
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+        pytest.skip("pallas TPU interpret mode unavailable in this jax build")
     rng = np.random.default_rng(seed)
     slots, mask, table = _random_case(rng, B=24, F=11)
     plan = plan_sorted_batch(slots, mask, S)
@@ -137,10 +139,12 @@ def test_pallas_interpret_matches_xla(seed):
 def test_rowsum_pallas_interpret_matches_xla():
     # the TPU row-sum kernel (scalar-core RMW into a VMEM-resident
     # accumulator), run in interpreter mode, must equal segment_sum
-    from jax.experimental.pallas import tpu as pltpu
+    pltpu = pytest.importorskip("jax.experimental.pallas.tpu")
 
     from xflow_tpu.ops.sorted_table import _rowsum_pallas
 
+    if not hasattr(pltpu, "force_tpu_interpret_mode"):
+        pytest.skip("pallas TPU interpret mode unavailable in this jax build")
     rng = np.random.default_rng(17)
     n, ch, rows_n = CHUNK, 24, 40
     rows = jnp.asarray(rng.integers(0, rows_n, n).astype(np.int32))

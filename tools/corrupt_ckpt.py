@@ -1,0 +1,80 @@
+"""Deliberately corrupt a checkpoint — the operator's fire drill.
+
+Shares its injectors with the tier-1 fault-injection tests
+(xflow_tpu/testing/faults.py), so rehearsing recovery on a staging
+checkpoint dir exercises EXACTLY the code paths the tests prove:
+truncate or bit-flip the newest (or a chosen) checkpoint, then run the
+normal resume and watch `restore_any` walk back to the previous
+committed step (docs/ROBUSTNESS.md).
+
+    # truncate the newest npz checkpoint to half its bytes
+    python tools/corrupt_ckpt.py --dir ckpt
+
+    # flip 8 random bits in a specific orbax step's data file
+    python tools/corrupt_ckpt.py --dir ckpt --format orbax \\
+        --step 1200 --mode bitflip
+
+    # corrupt an arbitrary file (no checkpoint-layout assumptions)
+    python tools/corrupt_ckpt.py --file ckpt/step_10/state.npz --mode truncate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xflow_tpu.testing.faults import (  # noqa: E402
+    bitflip_file,
+    corrupt_npz_checkpoint,
+    corrupt_orbax_checkpoint,
+    truncate_file,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deliberately corrupt a checkpoint (recovery drills)"
+    )
+    tgt = ap.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--dir", help="checkpoint dir (train.checkpoint_dir)")
+    tgt.add_argument("--file", help="corrupt this exact file instead")
+    ap.add_argument("--format", default="npz", choices=("npz", "orbax"),
+                    help="checkpoint format under --dir")
+    ap.add_argument("--step", type=int, default=None,
+                    help="step to corrupt (default: newest committed)")
+    ap.add_argument("--mode", default="truncate", choices=("truncate", "bitflip"))
+    ap.add_argument("--keep-frac", type=float, default=0.5,
+                    help="truncate: fraction of bytes to keep")
+    ap.add_argument("--offset", type=int, default=None,
+                    help="bitflip: pin the first flipped byte")
+    ap.add_argument("--count", type=int, default=8,
+                    help="bitflip: number of bytes to flip")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    kw = dict(keep_frac=args.keep_frac, offset=args.offset,
+              count=args.count, seed=args.seed)
+    if args.file:
+        if args.mode == "truncate":
+            truncate_file(args.file, keep_frac=args.keep_frac)
+        else:
+            bitflip_file(args.file, offset=args.offset, count=args.count,
+                         seed=args.seed)
+        path = args.file
+    elif args.format == "orbax":
+        path = corrupt_orbax_checkpoint(args.dir, step=args.step,
+                                        mode=args.mode, **kw)
+    else:
+        path = corrupt_npz_checkpoint(args.dir, step=args.step,
+                                      mode=args.mode, **kw)
+    print(json.dumps({"corrupted": path, "mode": args.mode,
+                      "size": os.path.getsize(path)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -218,6 +218,19 @@ class DataConfig:
     # same imbalance: one server owns the hot key) — raise this for
     # heavily skewed data; overflow fails loudly at plan time.
     fullshard_slack: float = 2.0
+    # bad-record budget (docs/ROBUSTNESS.md): a "bad" row is a labeled
+    # line whose features ALL failed to parse (zero masked occurrences).
+    # Both parsers keep such rows (a labeled line is an example), so an
+    # entire epoch of garbage would train in silently — the reference
+    # does exactly that (`load_data_from_disk.cc:150-153` skips
+    # malformed tokens with no signal). Detection is batch-level
+    # (row_mask on, feature mask all-zero), so the Python and native
+    # parsing paths count identically. -1 = count + warn only; >= 0 =
+    # raise BadRecordError once a file pass exceeds the budget.
+    max_bad_rows: int = -1
+    # "" = off; else bad rows are appended to this JSONL file
+    # (source path, batch/row index, label) for offline triage
+    quarantine_path: str = ""
 
 
 @dataclass(frozen=True)
@@ -269,6 +282,28 @@ class TrainConfig:
     # checkpoint_every cadence). One [1]-int32 host allgather per
     # `signal_sync_every` steps is the entire cost.
     signal_sync_every: int = 100
+    # non-finite guard (docs/ROBUSTNESS.md): every train step also
+    # returns an `update_ok` flag — one jnp.isfinite reduction over the
+    # loss and the updated table/optimizer leaves, computed INSIDE the
+    # SPMD program so multi-process ranks agree for free (the flag is
+    # replicated; no new host collectives). "skip" (default): a bad
+    # step's state update is discarded on device (jnp.where on the
+    # flag — no recompute), counted, and training continues; "halt":
+    # abort on the first bad step, after committing a checkpoint;
+    # "off": no check (a NaN batch silently poisons the tables — the
+    # reference behavior).
+    nonfinite_guard: str = "skip"
+    # under "skip", this many CONSECUTIVE discarded steps abort anyway
+    # (after a committed checkpoint): a stream of bad steps means the
+    # data or the state is systematically poisoned, and skipping
+    # forever would burn an epoch of compute learning nothing.
+    # 0 = never abort.
+    nonfinite_max_consecutive: int = 10
+    # checkpoint retention: keep the N newest COMMITTED checkpoints
+    # and sweep stale uncommitted step dirs after each save (a crashed
+    # save leaves a partial dir; readers already ignore it, this
+    # reclaims the space). 0 = keep everything.
+    keep_checkpoints: int = 0
 
 
 @dataclass(frozen=True)

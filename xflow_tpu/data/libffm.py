@@ -30,6 +30,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from xflow_tpu.hashing import fnv1a64, slot_of
+from xflow_tpu.jsonl import JsonlAppender
 
 _NUM_PREFIX = re.compile(r"^[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
 _HEX_PREFIX = re.compile(r"^[+-]?0[xX][0-9a-fA-F]+(?:\.[0-9a-fA-F]*)?(?:[pP][+-]?\d+)?")
@@ -141,6 +142,29 @@ def count_rows(path: str) -> int:
             if s and ("\t" in s or " " in s):
                 n += 1
     return n
+
+
+class QuarantineWriter(JsonlAppender):
+    """Append-only JSONL sink for bad (feature-less) records
+    (data.quarantine_path; docs/ROBUSTNESS.md).
+
+    One line per bad row: source path, batch/row position, label — enough
+    to locate the offending region of a shard for offline triage without
+    re-parsing the whole file. Lifecycle (lazy open with parent-dir
+    creation, flush-per-record, reopen-safe close) comes from the shared
+    appender (xflow_tpu/jsonl.py)."""
+
+    def __init__(self, path: str = ""):
+        super().__init__(path)
+        self.written = 0
+
+    def write(self, source: str, batch_index: int, row: int, label: float) -> None:
+        if not self._path:
+            return
+        self.append(
+            {"source": source, "batch": batch_index, "row": row, "label": label}
+        )
+        self.written += 1
 
 
 def available_shards(prefix: str) -> list[str]:

@@ -19,11 +19,89 @@ import subprocess
 import threading
 from typing import Iterable, Iterator, Optional
 
+import sys
+
 import numpy as np
 
 from xflow_tpu.config import DataConfig
 from xflow_tpu.data.schema import SparseBatch, make_batch
-from xflow_tpu.data.libffm import iter_examples
+from xflow_tpu.data.libffm import QuarantineWriter, iter_examples
+
+
+class BadRecordError(RuntimeError):
+    """A file pass produced more feature-less rows than data.max_bad_rows
+    allows — the input is likely garbage (wrong format, truncated upload,
+    corrupted shard) and training on it would silently learn nothing from
+    those rows. Raised BEFORE the epoch completes (docs/ROBUSTNESS.md)."""
+
+
+def bad_row_indices(batch: SparseBatch):
+    """Rows that are REAL (row_mask on) but parsed to ZERO features.
+
+    Both parsers keep such rows (a labeled line is an example even when
+    every feature token is malformed — reference parity,
+    `load_data_from_disk.cc:150-153`), so this batch-level predicate is
+    parser-agnostic by construction: the Python and native paths count
+    bad rows identically because the count is taken from the batches
+    they both emit, not from their internal line handling."""
+    rm = np.asarray(batch.row_mask) > 0
+    has_feature = np.asarray(batch.mask).max(axis=1) > 0 if batch.mask.size else rm
+    return np.nonzero(rm & ~has_feature)[0]
+
+
+def monitor_bad_rows(
+    batches: Iterator[SparseBatch],
+    cfg: DataConfig,
+    path: str,
+    enforce: bool = True,
+    quarantine: bool = True,
+) -> Iterator[SparseBatch]:
+    """Count (and optionally quarantine) feature-less rows in a batch
+    stream; with `enforce`, raise BadRecordError the moment the budget
+    is exceeded.
+
+    Bad rows are NOT dropped — dropping would break the row-counter /
+    parser parity the multi-process step coordination depends on
+    (`count_batches` counts every labeled line). They are counted,
+    appended to data.quarantine_path when set (and `quarantine` is on —
+    the trainer quarantines only the FIRST training pass over a path, so
+    the file holds one record per bad row, not one per epoch), and a
+    one-line stderr summary fires at end of stream. `enforce=False`
+    (eval/predict passes) still counts and warns but never raises: the
+    budget exists to stop garbage from TRAINING in, not to destroy a
+    finished model's eval. Multi-process note: the budget check runs on
+    each rank's own shard, so an over-budget shard aborts that rank
+    loudly (and the job with it) — a garbage shard is a data bug, not a
+    condition to coordinate around."""
+    budget = cfg.max_bad_rows
+    qw = QuarantineWriter(cfg.quarantine_path if quarantine else "")
+    total = 0
+    try:
+        for bi, batch in enumerate(batches):
+            idx = bad_row_indices(batch)
+            if idx.size:
+                labels = np.asarray(batch.labels)
+                for r in idx:
+                    qw.write(path, bi, int(r), float(labels[r]))
+                total += int(idx.size)
+                if enforce and 0 <= budget < total:
+                    raise BadRecordError(
+                        f"{path!r}: {total} feature-less row(s) exceed "
+                        f"data.max_bad_rows={budget} — the shard is likely "
+                        "malformed (wrong format / truncation / corruption); "
+                        "inspect it (data.quarantine_path records the bad "
+                        "rows) or raise the budget"
+                    )
+            yield batch
+        if total:
+            print(
+                f"xflow: warning: {path}: {total} row(s) parsed to zero "
+                f"features (budget data.max_bad_rows={budget})"
+                + (f"; quarantined to {cfg.quarantine_path}" if qw.written else ""),
+                file=sys.stderr,
+            )
+    finally:
+        qw.close()
 
 
 def examples_to_batches(
@@ -50,8 +128,27 @@ def batch_iterator(
     path: str,
     cfg: DataConfig,
     batch_size: Optional[int] = None,
+    enforce_bad_rows: bool = True,
+    quarantine: bool = True,
 ) -> Iterator[SparseBatch]:
-    """Stream padded batches from a libffm file, preferring the native parser."""
+    """Stream padded batches from a libffm file, preferring the native
+    parser. Every batch passes through the bad-record monitor
+    (`monitor_bad_rows`): feature-less rows are counted/quarantined
+    identically for both parser paths, and exceeding data.max_bad_rows
+    raises before an epoch of garbage trains in (eval passes set
+    `enforce_bad_rows=False`: count and warn, never kill a finished
+    model's predict pass)."""
+    yield from monitor_bad_rows(
+        _raw_batch_iterator(path, cfg, batch_size), cfg, path,
+        enforce=enforce_bad_rows, quarantine=quarantine,
+    )
+
+
+def _raw_batch_iterator(
+    path: str,
+    cfg: DataConfig,
+    batch_size: Optional[int] = None,
+) -> Iterator[SparseBatch]:
     bs = batch_size or cfg.batch_size
     if cfg.use_native_parser:
         native_iter = None
@@ -104,24 +201,53 @@ def count_batches(path: str, cfg: DataConfig, batch_size: Optional[int] = None) 
 
 
 def prefetch(iterator: Iterator[SparseBatch], depth: int = 2) -> Iterator[SparseBatch]:
-    """Run the parse/batch pipeline in a background thread with a bounded queue."""
+    """Run the parse/batch pipeline in a background thread with a bounded queue.
+
+    Abandonment-safe: when the consumer drops the generator mid-epoch
+    (an exception in the fit loop, an early break), its `close()`/GC
+    signals the worker through `stop` and drains the queue so a worker
+    blocked on a full `q.put` wakes, notices the flag, closes the
+    underlying iterator (releasing native parser handles / quarantine
+    files promptly), and exits — previously it blocked on `q.put`
+    forever, leaking one thread (and pinning its batch buffers) per
+    abandoned epoch."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
+    stop = threading.Event()
 
     def worker() -> None:
         try:
             for item in iterator:
                 q.put(item)
+                if stop.is_set():
+                    return
             q.put(_END)
         except BaseException as e:  # re-raised in the consumer
             q.put(e)
+        finally:
+            if stop.is_set():
+                close = getattr(iterator, "close", None)
+                if close is not None:
+                    close()
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, daemon=True, name="xflow-prefetch")
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            break
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # unblock a worker stuck in q.put: after the drain there is at
+        # least one free slot, so its pending put completes, it sees the
+        # flag, and exits (putting at most one more item, which fits)
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=10.0)

@@ -1,0 +1,38 @@
+"""Lazy append-mode JSONL sink, shared by the metrics stream
+(train/trainer.py MetricsLogger) and the bad-record quarantine
+(data/libffm.py QuarantineWriter) so the lifecycle mechanics live once.
+
+Lifecycle: the file opens on the FIRST record (creating the parent
+directory — a path inside a not-yet-existing run dir must not crash the
+construction), every record is flushed (a crash loses nothing already
+appended), and `close()` flushes, closes, and returns the sink to its
+lazy state — a later append transparently reopens in append mode
+instead of writing to a closed handle. An empty path disables the sink
+entirely (every call is a no-op)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class JsonlAppender:
+    def __init__(self, path: str = ""):
+        self._path = path
+        self._f = None
+
+    def append(self, record: dict) -> None:
+        if not self._path:
+            return
+        if self._f is None:
+            parent = os.path.dirname(self._path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f = open(self._path, "a")
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -84,6 +84,7 @@ def cmd_train(args) -> int:
         "examples_per_sec": round(res.examples_per_sec, 1),
         "last_loss": res.last_loss,
         "occupancy": res.occupancy,
+        "bad_steps": res.bad_steps,
     }
     if res.interrupted:
         # preempted: checkpoint was saved at the last step boundary; skip

@@ -1,0 +1,28 @@
+"""jax API compatibility shims for the parallel engines.
+
+The engines are written against the current stable surface
+(`jax.shard_map`, `check_vma=`); older runtimes (jax <= 0.4.x, which
+some CI images pin) expose the same primitive as
+`jax.experimental.shard_map.shard_map` with the flag spelled
+`check_rep=`. One shim keeps every call site on the modern spelling.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """`jax.shard_map` with fallback to the pre-0.5 experimental API
+    (`check_vma` maps onto the old `check_rep`)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -70,8 +70,10 @@ from xflow_tpu.ops.sorted_table import (
     row_sums_sorted,
     table_gather_sorted_multi,
 )
+from xflow_tpu.parallel.compat import shard_map
 from xflow_tpu.parallel.mesh import DATA_AXIS, TABLE_AXIS
 from xflow_tpu.train.state import TrainState
+from xflow_tpu.train.step import guard_nonfinite, metrics_keys
 
 FS_KEYS = ("fs_slots", "fs_row", "fs_mask", "fs_off")
 
@@ -423,7 +425,7 @@ def make_fullshard_eval_step(cfg: Config, mesh: Mesh) -> Callable:
         with_fields = mode in ("mvm_segment", "ffm")
 
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(
                 P((DATA_AXIS, TABLE_AXIS), None),
@@ -506,7 +508,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
         with_fields = mode in ("mvm_segment", "ffm")
 
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(
                 P((DATA_AXIS, TABLE_AXIS), None),  # table shard [S/(D*T), K]
@@ -544,7 +546,14 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
                 {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
             )
             metrics = {"loss": loss, "rows": rows}
-            return TrainState(new_tables, new_opt, state.step + 1), metrics
+            # non-finite guard: update_ok computed from replicated loss +
+            # the sharded updated leaves (the isfinite reduction GSPMDs to
+            # shard-local alls + one psum) — every rank/device sees the
+            # same flag, so the jnp.where discard stays rank-symmetric
+            return guard_nonfinite(
+                cfg, state, TrainState(new_tables, new_opt, state.step + 1),
+                metrics,
+            )
 
         return train_step, fullshard_batch_sharding(mesh, with_fields=with_fields)
 
@@ -562,7 +571,7 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
                 jax.jit(
                     step,
                     in_shardings=(ssh, bsh),
-                    out_shardings=(ssh, {"loss": rep, "rows": rep}),
+                    out_shardings=(ssh, {k: rep for k in metrics_keys(cfg)}),
                     donate_argnums=(0,),
                 ),
                 bsh,

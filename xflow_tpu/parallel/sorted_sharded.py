@@ -50,8 +50,10 @@ from xflow_tpu.ops.sorted_table import (
     row_sums_sorted,
     table_gather_sorted,
 )
+from xflow_tpu.parallel.compat import shard_map
 from xflow_tpu.parallel.mesh import DATA_AXIS, TABLE_AXIS
 from xflow_tpu.train.state import TrainState
+from xflow_tpu.train.step import guard_nonfinite, metrics_keys
 
 
 def validate_sorted_sharded(cfg: Config, mesh: Mesh) -> None:
@@ -155,7 +157,7 @@ def make_sorted_sharded_train_step(
         return loss_sum / jnp.maximum(rows, 1.0), rows
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(TABLE_AXIS, None),  # wv shard
@@ -195,7 +197,12 @@ def make_sorted_sharded_train_step(
             cfg,
         )
         metrics = {"loss": loss, "rows": rows}
-        return TrainState(new_tables, new_opt, state.step + 1), metrics
+        # non-finite guard: same shared helper as every other engine
+        # (train/step.py guard_nonfinite) — the discard select runs on
+        # the sharded leaves, the flag is replicated
+        return guard_nonfinite(
+            cfg, state, TrainState(new_tables, new_opt, state.step + 1), metrics
+        )
 
     table_sh = NamedSharding(mesh, P(TABLE_AXIS, None))
     opt_sh = {"wv": {"n": table_sh, "z": table_sh}}
@@ -211,7 +218,7 @@ def make_sorted_sharded_train_step(
     jitted = jax.jit(
         train_step,
         in_shardings=(state_sh, bsh),
-        out_shardings=(state_sh, {"loss": rep, "rows": rep}),
+        out_shardings=(state_sh, {k: rep for k in metrics_keys(cfg)}),
         donate_argnums=(0,),
     )
 

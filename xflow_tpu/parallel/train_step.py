@@ -27,7 +27,7 @@ from xflow_tpu.models.base import Model
 from xflow_tpu.optim.base import Optimizer
 from xflow_tpu.parallel.mesh import batch_sharding, replicated, state_shardings
 from xflow_tpu.train.state import TrainState
-from xflow_tpu.train.step import make_train_step, make_eval_step
+from xflow_tpu.train.step import make_train_step, make_eval_step, metrics_keys
 
 
 def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
@@ -47,7 +47,9 @@ def make_sharded_train_step(
     def sharded(state: TrainState, batch: dict):
         return step(state, batch)
 
-    out_metrics_sh = {"loss": replicated(mesh), "rows": replicated(mesh)}
+    # the non-finite guard's update_ok flag rides in the metrics dict
+    # (train/step.py metrics_keys), replicated like loss/rows
+    out_metrics_sh = {k: replicated(mesh) for k in metrics_keys(cfg)}
 
     def wrap(state: TrainState, batch: dict):
         ssh = state_shardings(state, mesh)

@@ -1,0 +1,3 @@
+"""Test-support utilities shipped with the package (not tests themselves):
+fault injectors (testing/faults.py) shared by the tier-1 fault-injection
+suite and operator tooling (tools/corrupt_ckpt.py)."""

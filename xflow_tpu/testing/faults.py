@@ -1,0 +1,225 @@
+"""Fault injectors for the resilience subsystem (docs/ROBUSTNESS.md).
+
+One shared library drives every recovery path end-to-end — the tier-1
+fault-injection tests (tests/test_fault_injection.py) and the operator
+CLI (tools/corrupt_ckpt.py) call the SAME functions, so what the tests
+prove recoverable is exactly what an operator can rehearse against a
+real checkpoint dir:
+
+- `poison_nan_batches`: wrap a Trainer so chosen steps' labels become
+  NaN — the non-finite guard's trigger (`train.nonfinite_guard`).
+- `truncate_file` / `bitflip_file`: byte-level corruption primitives.
+- `corrupt_npz_checkpoint` / `corrupt_orbax_checkpoint`: apply them to
+  the newest (or a chosen) checkpoint — the self-healing restore's
+  trigger (`checkpoint.restore_any`).
+- `write_malformed_libffm`: shards mixing good rows with junk labels,
+  feature-less lines, separators-only lines, and a truncated final
+  line — the bad-record quarantine's trigger (`data.max_bad_rows`) and
+  the counter/parser parity tests' input.
+
+The reference has no analog: it neither checkpoints nor validates input
+(SURVEY.md §5 A3), so every one of these faults is either fatal or
+silent there.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+# ------------------------------------------------------------- byte faults
+def truncate_file(path: str, keep_frac: float = 0.5,
+                  keep_bytes: Optional[int] = None) -> int:
+    """Truncate `path` to `keep_bytes` (or keep_frac of its size).
+    Returns the new size. Emulates a crashed/partial write."""
+    size = os.path.getsize(path)
+    keep = keep_bytes if keep_bytes is not None else int(size * keep_frac)
+    keep = max(0, min(size, keep))
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return keep
+
+
+def bitflip_file(path: str, offset: Optional[int] = None, count: int = 8,
+                 seed: int = 0) -> list[int]:
+    """Flip one bit in each of `count` bytes (random offsets from `seed`
+    unless `offset` pins the first). Returns the offsets touched.
+    Emulates silent media/transfer corruption."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return []
+    rng = random.Random(seed)
+    offsets = sorted(
+        {offset if offset is not None and i == 0 else rng.randrange(size)
+         for i in range(count)}
+    )
+    with open(path, "rb+") as f:
+        for off in offsets:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+    return offsets
+
+
+# ------------------------------------------------------ checkpoint corruption
+def _apply(path: str, mode: str, **kw) -> str:
+    if mode == "truncate":
+        truncate_file(path, **{k: v for k, v in kw.items()
+                               if k in ("keep_frac", "keep_bytes")})
+    elif mode == "bitflip":
+        bitflip_file(path, **{k: v for k, v in kw.items()
+                              if k in ("offset", "count", "seed")})
+    else:
+        raise ValueError(f"mode={mode!r}: expected truncate|bitflip")
+    return path
+
+
+def corrupt_npz_checkpoint(ckpt_dir: str, step: Optional[int] = None,
+                           mode: str = "truncate", **kw) -> str:
+    """Corrupt `state.npz` of the newest (or given) COMMITTED checkpoint.
+    The commit marker is left intact — the point is a checkpoint that
+    LOOKS valid and fails only when read, the case restore_any heals."""
+    from xflow_tpu.train.checkpoint import committed_steps
+
+    if step is None:
+        steps = committed_steps(ckpt_dir)
+        if not steps:
+            raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir!r}")
+        step = steps[0]
+    return _apply(os.path.join(ckpt_dir, f"step_{step}", "state.npz"), mode, **kw)
+
+
+def corrupt_orbax_checkpoint(ckpt_dir: str, step: Optional[int] = None,
+                             mode: str = "truncate",
+                             target: str = "manifest", **kw) -> str:
+    """Corrupt a file inside the newest (or given) orbax checkpoint dir.
+
+    target="manifest" (default): the top-level OCDBT manifest — the torn
+    partial-upload scenario; its loss makes restore fail LOUDLY
+    (DATA_LOSS), which is what restore_any's walk-back heals.
+    target="largest": the biggest data file (the table shards). CAVEAT,
+    measured on this tensorstore: byte corruption THERE restores without
+    error and yields wrong values — OCDBT data reads are not
+    checksum-verified, unlike npz (zip CRC32 catches every flip). Use
+    npz where end-to-end integrity matters (docs/ROBUSTNESS.md)."""
+    from xflow_tpu.train.checkpoint import orbax_steps
+
+    if step is None:
+        steps = orbax_steps(ckpt_dir)
+        if not steps:
+            raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir!r}")
+        step = steps[0]
+    root = os.path.join(ckpt_dir, f"orbax_step_{step}")
+    if target == "manifest":
+        victim = os.path.join(root, "manifest.ocdbt")
+        if not os.path.exists(victim):
+            raise FileNotFoundError(f"no OCDBT manifest under {root!r}")
+    elif target == "largest":
+        victim, largest_size = None, -1
+        for dirpath, _, files in os.walk(root):
+            for name in files:
+                p = os.path.join(dirpath, name)
+                s = os.path.getsize(p)
+                if s > largest_size:
+                    victim, largest_size = p, s
+        if victim is None:
+            raise FileNotFoundError(f"no files under {root!r}")
+    else:
+        raise ValueError(f"target={target!r}: expected manifest|largest")
+    return _apply(victim, mode, **kw)
+
+
+# ------------------------------------------------------------- data faults
+def poison_nan_batches(trainer, steps: Iterable[int],
+                       value: float = float("nan")) -> None:
+    """Make the trainer's batch stream deliver `value` as every label of
+    the 1-based global step indices in `steps` (counted across epochs).
+
+    Injection happens at the (batch, arrays) seam the fit loop consumes
+    — after parsing, before device transfer — because libffm labels
+    cannot be non-finite by construction (label = 1 iff strtod(tok) >
+    1e-7), so a NaN batch models an upstream feature-store bug, exactly
+    the failure the non-finite guard exists for."""
+    bad = set(int(s) for s in steps)
+    orig = trainer._coordinated_batches
+    counter = [0]
+
+    def wrapped(path, *args, **kwargs):
+        # only TRAINING streams advance the step counter: eval/predict
+        # passes announce themselves with enforce_bad_rows=False, and
+        # counting their batches would drift the poisoned indices off
+        # the fit loop's steps whenever train.eval_every interleaves
+        # eval passes between epochs
+        training = kwargs.get("enforce_bad_rows", True)
+        for batch, arrays in orig(path, *args, **kwargs):
+            if training:
+                counter[0] += 1
+                if counter[0] in bad:
+                    arrays = dict(arrays)
+                    arrays["labels"] = np.full_like(
+                        np.asarray(arrays["labels"]), value
+                    )
+            yield batch, arrays
+
+    trainer._coordinated_batches = wrapped
+
+
+def write_malformed_libffm(path: str, n_good: int = 40, n_bad: int = 6,
+                           n_junk_label: int = 4, n_nonrows: int = 5,
+                           seed: int = 0, truncated_tail: bool = False) -> dict:
+    """Write a libffm shard mixing good rows with malformed content.
+
+    Composition (shuffled, seeded):
+    - `n_good` well-formed rows (`label\\tf:id:1 ...`);
+    - `n_bad` BAD rows: labeled lines whose every feature token is
+      malformed (no ':'), so they parse to zero features — counted
+      rows, quarantine fodder;
+    - `n_junk_label` rows with junk labels but valid features (strtod
+      yields 0.0 → label 0; the row itself is fine);
+    - `n_nonrows` lines that are NOT rows for either parser: empty,
+      whitespace-only, and label-only lines without a separator;
+    - `truncated_tail`: ends the file mid-token without a newline (a
+      torn write); the partial line still contains a separator, so both
+      the counters and the parsers must agree on treating it as a row.
+
+    Returns {"rows": ..., "bad": ..., "lines": ...} where `rows` is the
+    count BOTH `count_rows` and `native_count_rows` must report and both
+    parsers must yield, and `bad` the zero-feature subset.
+    """
+    rng = random.Random(seed)
+    lines = []
+    for i in range(n_good):
+        toks = " ".join(
+            f"{f}:{rng.randrange(1000)}:1" for f in range(rng.randrange(1, 5))
+        )
+        lines.append((f"{rng.randrange(2)}\t{toks}", "good"))
+    for i in range(n_bad):
+        junk = " ".join(rng.choice(["garbage", "??", "novalue", "a_b"])
+                        for _ in range(rng.randrange(1, 3)))
+        lines.append((f"{rng.randrange(2)}\t{junk}", "bad"))
+    for i in range(n_junk_label):
+        lines.append((f"abc{i}\t0:{rng.randrange(1000)}:1", "junk_label"))
+    # non-rows for BOTH parsers: empty, whitespace-only (incl. a lone
+    # tab, which strips to empty), and label-only lines with no separator
+    nonrows = ["", "   ", "\t", "1", "justalabel"][:n_nonrows]
+    lines.extend((l, "nonrow") for l in nonrows)
+    rng.shuffle(lines)
+    tail = None
+    if truncated_tail:
+        # a separator is present, the final token is torn mid-way
+        tail = "1\t3:12345"
+    rows = sum(1 for _, kind in lines if kind != "nonrow")
+    bad = sum(1 for _, kind in lines if kind == "bad")
+    with open(path, "w") as f:
+        for text, _ in lines:
+            f.write(text + "\n")
+        if tail is not None:
+            f.write(tail)  # no trailing newline
+    if tail is not None:
+        rows += 1
+    return {"rows": rows, "bad": bad, "lines": len(lines) + (1 if tail else 0)}

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
 import sys
 from typing import Optional
 
@@ -60,6 +61,33 @@ def _flatten(state: TrainState, logical_widths: Optional[dict] = None) -> dict:
     return flat
 
 
+def _write_atomic(path: str, writer) -> None:
+    """Write a file through a temp name + fsync + os.replace + dir fsync,
+    so a crash mid-write can never leave a half-written file under the
+    final name (a truncated `state.npz` in a COMMITTED dir would defeat
+    the commit-marker protocol — the marker only witnesses ordering, not
+    write atomicity). The fsyncs extend the guarantee to power/kernel
+    loss: without them, default ext4/xfs can journal the rename before
+    the data blocks land, committing a zero-filled file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        writer(tmp)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
 def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None) -> str:
     """Write a checkpoint; returns its path.
 
@@ -69,23 +97,46 @@ def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None
     is Orbax-based (see OrbaxCheckpointer below when available).
     `logical_widths` ({table: K}) unpacks packed storage so the file is
     layout-independent (_unpack_host).
+
+    Crash-safety: a pre-existing UNCOMMITTED step dir (a prior save that
+    died mid-write) is removed first so one dir never mixes two
+    generations of files; each file lands via temp name + os.replace;
+    the COMMITTED marker is written last.
     """
     step = int(state.step)
     path = os.path.join(ckpt_dir, f"step_{step}")
     flat = _flatten(state, logical_widths)  # collective: all ranks participate
     if jax.process_index() == 0:
+        if os.path.isdir(path) and not os.path.exists(
+            os.path.join(path, "COMMITTED")
+        ):
+            shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, "state.npz"), **flat)
+        def write_npz(p):
+            # a file OBJECT, not a path: np.savez appends ".npz" to bare
+            # paths, which would break the temp-name + os.replace dance
+            with open(p, "wb") as f:
+                np.savez(f, **flat)
+
+        _write_atomic(os.path.join(path, "state.npz"), write_npz)
         meta = {
             "step": step,
             "tables": sorted(state.tables),
             "format": "npz",
         }
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f)
+
+        def write_json(p):
+            with open(p, "w") as f:
+                json.dump(meta, f)
+
+        _write_atomic(os.path.join(path, "meta.json"), write_json)
+
+        def write_marker(p):
+            with open(p, "w") as f:
+                f.write("ok\n")
+
         # commit marker last: readers treat directories without it as partial
-        with open(os.path.join(path, "COMMITTED"), "w") as f:
-            f.write("ok\n")
+        _write_atomic(os.path.join(path, "COMMITTED"), write_marker)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -93,15 +144,106 @@ def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None
     return path
 
 
-def latest_step(ckpt_dir: str) -> Optional[int]:
+def committed_steps(ckpt_dir: str) -> list[int]:
+    """All COMMITTED npz checkpoint steps, newest first."""
     if not os.path.isdir(ckpt_dir):
-        return None
+        return []
     steps = []
     for name in os.listdir(ckpt_dir):
         m = _STEP_RE.match(name)
         if m and os.path.exists(os.path.join(ckpt_dir, name, "COMMITTED")):
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps, reverse=True)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = committed_steps(ckpt_dir)
+    return steps[0] if steps else None
+
+
+def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
+    """Retention sweep after a successful save (train.keep_checkpoints).
+
+    Removes (a) committed checkpoints beyond the `keep` newest (keep <= 0
+    keeps everything) and (b) stale crashed-save debris regardless of
+    `keep`: uncommitted npz step dirs, and orbax's own temp dirs
+    (`*.orbax-checkpoint-tmp-*`) — the save that just committed proves no
+    writer is using them. Only process 0 mutates the filesystem (the same
+    rank that writes npz checkpoints). Returns the removed paths."""
+    removed = []
+    if jax.process_index() != 0 or not os.path.isdir(ckpt_dir):
+        return removed
+    if fmt == "orbax":
+        steps = orbax_steps(ckpt_dir)
+        doomed = [f"orbax_step_{s}" for s in (steps[keep:] if keep > 0 else [])]
+        # stale-debris sweep, orbax flavor: a save killed mid-write leaves
+        # orbax's own temp dir (`orbax_step_N.orbax-checkpoint-tmp-...`),
+        # which never matches orbax_steps and would leak forever
+        for name in os.listdir(ckpt_dir):
+            if name.startswith("orbax_step_") and ".orbax-checkpoint-tmp" in name:
+                doomed.append(name)
+    else:
+        steps = committed_steps(ckpt_dir)
+        live = set(steps[:keep] if keep > 0 else steps)
+        doomed = []
+        for name in os.listdir(ckpt_dir):
+            m = _STEP_RE.match(name)
+            if m and int(m.group(1)) not in live:
+                doomed.append(name)
+    for name in doomed:
+        p = os.path.join(ckpt_dir, name)
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+    return removed
+
+
+def restore_any(ckpt_dir: str, like: TrainState, fmt: str = "npz"):
+    """Self-healing restore: walk back from the newest committed step.
+
+    Returns (state, step). A checkpoint that fails to load — truncated
+    npz, bit-flipped orbax shard, unreadable metadata — is logged with
+    the reason and SKIPPED, and the previous committed step is tried,
+    instead of one corrupt file killing a resumable run. Raises
+    FileNotFoundError when no checkpoint exists at all, RuntimeError
+    (listing every failure) when none of the existing ones loads."""
+    steps = orbax_steps(ckpt_dir) if fmt == "orbax" else committed_steps(ckpt_dir)
+    if not steps:
+        raise FileNotFoundError(
+            f"no {'orbax' if fmt == 'orbax' else 'committed'} checkpoint "
+            f"under {ckpt_dir!r}"
+        )
+    errors = []
+    for step in steps:
+        try:
+            if fmt == "orbax":
+                state = restore_orbax(ckpt_dir, like, step=step)
+            else:
+                state = restore(ckpt_dir, like, step=step)
+        except Exception as e:  # noqa: BLE001 — every failure mode of a
+            # corrupt file (BadZipFile, zlib.error, OSError, orbax/
+            # tensorstore errors, shape mismatches) must take the
+            # walk-back path; each is logged with its reason below
+            print(
+                f"# checkpoint: step {step} failed to load "
+                f"({type(e).__name__}: {e}); trying the previous "
+                "committed step",
+                file=sys.stderr,
+            )
+            errors.append((step, e))
+            continue
+        if errors:
+            print(
+                f"# checkpoint: restored step {step} after skipping "
+                f"{len(errors)} unreadable checkpoint(s): "
+                + ", ".join(str(s) for s, _ in errors),
+                file=sys.stderr,
+            )
+        return state, step
+    raise RuntimeError(
+        f"no loadable checkpoint under {ckpt_dir!r} — all "
+        f"{len(errors)} candidates failed: "
+        + "; ".join(f"step {s}: {type(e).__name__}: {e}" for s, e in errors)
+    )
 
 
 def _fused_alias(lookup, tbl: str, like: TrainState):
@@ -252,15 +394,23 @@ def save_orbax(ckpt_dir: str, state: TrainState) -> str:
     return path
 
 
-def latest_orbax_step(ckpt_dir: str) -> Optional[int]:
+def orbax_steps(ckpt_dir: str) -> list[int]:
+    """All orbax checkpoint steps, newest first (orbax finalizes a save
+    by renaming its tmp dir, so presence under the final name means the
+    write completed — the OCDBT analog of the npz COMMITTED marker)."""
     if not os.path.isdir(ckpt_dir):
-        return None
+        return []
     steps = []
     for name in os.listdir(ckpt_dir):
         m = re.match(r"^orbax_step_(\d+)$", name)
         if m:
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps, reverse=True)
+
+
+def latest_orbax_step(ckpt_dir: str) -> Optional[int]:
+    steps = orbax_steps(ckpt_dir)
+    return steps[0] if steps else None
 
 
 def _orbax_stored_shapes(path: str) -> Optional[dict]:
@@ -280,7 +430,11 @@ def _orbax_stored_shapes(path: str) -> Optional[dict]:
 
     try:
         with ocp.PyTreeCheckpointer() as ckptr:
-            tree = ckptr.metadata(path).item_metadata.tree
+            md = ckptr.metadata(path)
+        # orbax API drift: older releases (e.g. 0.7.x) return the metadata
+        # tree itself (a dict of ArrayMetadata); newer ones wrap it as
+        # CheckpointMetadata.item_metadata.tree
+        tree = md if isinstance(md, dict) else md.item_metadata.tree
         if tree is None:
             return None
         walk("", tree)

@@ -58,6 +58,55 @@ def loss_fn(tables, batch, model: Model, cfg: Config):
     return masked_mean_logloss(logits, batch["labels"], batch["row_mask"])
 
 
+def nonfinite_guard_on(cfg: Config) -> bool:
+    """Validate train.nonfinite_guard and return whether the guard runs."""
+    g = cfg.train.nonfinite_guard
+    if g not in ("off", "skip", "halt"):
+        raise ValueError(
+            f"train.nonfinite_guard={g!r}: expected off|skip|halt"
+        )
+    return g != "off"
+
+
+def guard_nonfinite(cfg: Config, state: TrainState, new_state: TrainState, metrics: dict):
+    """Fold the non-finite update guard into one step's result.
+
+    `update_ok` = the loss AND every updated table/optimizer leaf are
+    finite, as ONE isfinite reduction per leaf fused into the step (the
+    optimizer sweep already touches every element, so the extra HBM
+    traffic is ~zero on the two-pass paths). On a bad step the whole
+    update is discarded by `jnp.where` on the flag — no recompute, the
+    previous state rides through. The step counter still advances, so
+    checkpoint names stay monotonic.
+
+    Shared by all four step builders (single-device, GSPMD, fullshard,
+    replicated sorted) so their guard semantics cannot drift. The flag
+    is computed inside the SPMD program from replicated values, so every
+    multi-process rank sees the same bit with no host collective — the
+    trainer's skip/halt bookkeeping stays rank-symmetric for free.
+    """
+    if not nonfinite_guard_on(cfg):
+        return new_state, metrics
+    ok = jnp.isfinite(metrics["loss"])
+    for leaf in jax.tree.leaves((new_state.tables, new_state.opt_state)):
+        ok = ok & jnp.isfinite(leaf).all()
+    keep = lambda new, old: jnp.where(ok, new, old)
+    guarded = TrainState(
+        tables=jax.tree.map(keep, new_state.tables, state.tables),
+        opt_state=jax.tree.map(keep, new_state.opt_state, state.opt_state),
+        step=new_state.step,
+    )
+    return guarded, dict(metrics, update_ok=ok)
+
+
+def metrics_keys(cfg: Config) -> tuple:
+    """The step-metrics dict keys under this config — the sharded step
+    builders derive their out_shardings pytrees from this so the guard's
+    extra flag never desyncs a jit contract."""
+    base = ("loss", "rows")
+    return base + (("update_ok",) if nonfinite_guard_on(cfg) else ())
+
+
 def _fused_scatter_eligible(cfg: Config, allow_fused: bool) -> bool:
     """Fused scatter+FTRL (cfg.optim.fused_scatter, ops/sorted_table
     .scatter_ftrl_sorted) applies to the single-device sorted fused-FM
@@ -185,7 +234,13 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
             )
         )
         if fuse and fusable:
-            return _fused_sorted_step(state, batch, cfg)
+            new_state, metrics = _fused_sorted_step(state, batch, cfg)
+            # guard note: selecting against the pre-step table forces XLA
+            # to keep it live across the fused scatter, giving back the
+            # table-sized transient the fusion removed — the price of
+            # discardable updates (docs/ROBUSTNESS.md); set
+            # train.nonfinite_guard=off to reclaim it
+            return guard_nonfinite(cfg, state, new_state, metrics)
         if fuse and cfg.optim.fused_scatter == "on":
             raise ValueError(
                 "optim.fused_scatter=on but this batch has no flat "
@@ -198,7 +253,9 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
         loss, grads = jax.value_and_grad(loss_fn)(state.tables, batch, model, cfg)
         new_tables, new_opt = optimizer.apply(state.tables, state.opt_state, grads, cfg)
         metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
-        return TrainState(new_tables, new_opt, state.step + 1), metrics
+        return guard_nonfinite(
+            cfg, state, TrainState(new_tables, new_opt, state.step + 1), metrics
+        )
 
     if jit:
         # donate the state: tables and optimizer state update in place in HBM

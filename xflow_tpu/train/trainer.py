@@ -11,7 +11,6 @@ one jitted SPMD step; then an eval pass that dumps
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import time
@@ -24,12 +23,25 @@ import numpy as np
 
 from xflow_tpu.config import Config
 from xflow_tpu.data.libffm import shard_path
+from xflow_tpu.jsonl import JsonlAppender
 from xflow_tpu.data.pipeline import batch_iterator, count_batches, prefetch
 from xflow_tpu.metrics import auc_logloss
 from xflow_tpu.models import get_model
 from xflow_tpu.optim import get_optimizer
 from xflow_tpu.train.state import TrainState, init_state
-from xflow_tpu.train.step import batch_to_arrays, make_eval_step, make_train_step
+from xflow_tpu.train.step import (
+    batch_to_arrays,
+    make_eval_step,
+    make_train_step,
+    nonfinite_guard_on,
+)
+
+
+class NonFiniteHalt(RuntimeError):
+    """Raised by fit() when the non-finite guard aborts the run
+    (train.nonfinite_guard=halt, or nonfinite_max_consecutive discarded
+    steps in a row under skip). A checkpoint of the last GOOD state was
+    committed before raising whenever train.checkpoint_dir is set."""
 
 
 @dataclass
@@ -43,6 +55,7 @@ class TrainResult:
     logloss: float = float("nan")
     occupancy: dict = field(default_factory=dict)
     interrupted: int = 0  # signal number when preempted mid-run (A3)
+    bad_steps: int = 0  # non-finite updates discarded by the guard
 
     @property
     def examples_per_sec(self) -> float:
@@ -59,20 +72,16 @@ def resolve_eval_buckets(value: int, multiproc: bool) -> int:
     return value if value >= 0 else (65536 if multiproc else 0)
 
 
-class MetricsLogger:
-    """Structured per-step metrics: JSONL to a file, or quiet."""
+class MetricsLogger(JsonlAppender):
+    """Structured per-step metrics: JSONL to a file, or quiet.
 
-    def __init__(self, path: str = ""):
-        self._f = open(path, "a") if path else None
+    Lifecycle (lazy open with parent-dir creation, flush-per-record,
+    reopen-safe close) comes from the shared appender (xflow_tpu/jsonl.py)
+    — fit() closes the logger in its finally, and a later record (a
+    second fit() on the same Trainer) transparently reopens in append
+    mode."""
 
-    def log(self, record: dict) -> None:
-        if self._f:
-            self._f.write(json.dumps(record) + "\n")
-            self._f.flush()
-
-    def close(self) -> None:
-        if self._f:
-            self._f.close()
+    log = JsonlAppender.append
 
 
 class Trainer:
@@ -286,6 +295,9 @@ class Trainer:
         )
         self._dedup_on = None  # undecided until the first row-major batch
         self.metrics = MetricsLogger(cfg.train.metrics_path)
+        # validate the guard mode at CONSTRUCTION (identical config on
+        # every rank → rank-symmetric), not on the first bad batch
+        self._guarded = nonfinite_guard_on(cfg)
         self._fullshard_overflow_warned = False
         # MVM and FFM key their views/blocks on the field id: a field >=
         # num_fields would be silently dropped by the one-hot, so reject
@@ -597,7 +609,13 @@ class Trainer:
         self._check_batch(batch)
         return batch, self._batch_arrays(batch, with_plan=with_plan)
 
-    def _coordinated_batches(self, path: str, with_plan: bool = True):
+    def _coordinated_batches(
+        self,
+        path: str,
+        with_plan: bool = True,
+        enforce_bad_rows: bool = True,
+        quarantine: bool = True,
+    ):
         """Yield exactly the globally-agreed number of (batch, arrays)
         pairs for `path`, padding with fully-masked empty batches once
         local input is exhausted. One counting allgather per (path,
@@ -606,21 +624,30 @@ class Trainer:
         the batch stream itself adds no host collectives (the fullshard
         overflow flag, when that engine is on, is the fit loop's, not
         this iterator's). `with_plan` false skips sorted-plan building
-        (mesh eval runs row-major)."""
+        (mesh eval runs row-major); `enforce_bad_rows`/`quarantine`
+        thread through to the bad-record monitor (eval passes count but
+        never raise; only the first training pass quarantines)."""
+
         prepare = lambda b: self._with_arrays(b, with_plan=with_plan)
+
+        def feed():
+            # a REAL generator (map objects have no close): prefetch's
+            # abandonment path close()s it, which cascades into
+            # batch_iterator's finally — native parser handles and the
+            # quarantine file release promptly, not at some later GC
+            for b in batch_iterator(
+                path, self.cfg.data,
+                enforce_bad_rows=enforce_bad_rows, quarantine=quarantine,
+            ):
+                yield prepare(b)
+
         if jax.process_count() == 1:
-            yield from prefetch(
-                map(prepare, batch_iterator(path, self.cfg.data))
-            )
+            yield from prefetch(feed())
             return
         global_steps, local = self._global_batch_count(path)
         # open the real iterator whenever the file exists (even if counted
         # 0) so the drift check below can catch a counter that under-reads
-        it = (
-            iter(prefetch(map(prepare, batch_iterator(path, self.cfg.data))))
-            if os.path.exists(path)
-            else iter(())
-        )
+        it = iter(prefetch(feed())) if os.path.exists(path) else iter(())
         produced = 0
         for _ in range(global_steps):
             pair = next(it, None)
@@ -688,6 +715,14 @@ class Trainer:
         return flag, restore
 
     def fit(self, train_path: Optional[str] = None) -> TrainResult:
+        try:
+            return self._fit(train_path)
+        finally:
+            # release the metrics handle even on abnormal exit; a later
+            # log() on this Trainer transparently reopens in append mode
+            self.metrics.close()
+
+    def _fit(self, train_path: Optional[str] = None) -> TrainResult:
         cfg = self.cfg
         path = train_path or shard_path(cfg.data.train_path, self.rank)
         res = TrainResult()
@@ -698,6 +733,43 @@ class Trainer:
         sig_flag, sig_restore = self._install_signal_checkpoint()
         multiproc = jax.process_count() > 1
         sync_every = cfg.train.signal_sync_every
+        guard_halt = cfg.train.nonfinite_guard == "halt"
+        max_consec = cfg.train.nonfinite_max_consecutive
+        bad_run = 0  # consecutive discarded steps
+        halted = False
+        pending_ok = None  # (metrics, step index) awaiting the flag check
+
+        def check_pending() -> bool:
+            """Consume the PREVIOUS step's update_ok flag. Called right
+            AFTER the next step's async dispatch, so the host read
+            overlaps that step's device execution instead of inserting a
+            sync bubble before it (the flag is replicated, so the read
+            is collective-free and every rank computes the same
+            skip/halt decision). Returns True when the guard demands an
+            abort."""
+            nonlocal pending_ok, bad_run
+            if pending_ok is None:
+                return False
+            m, at_step = pending_ok
+            pending_ok = None
+            if "update_ok" not in m or bool(m["update_ok"]):
+                bad_run = 0
+                return False
+            res.bad_steps += 1
+            bad_run += 1
+            self.metrics.log(
+                {
+                    "step": at_step,
+                    "nonfinite_skipped": True,
+                    "bad_steps": res.bad_steps,
+                }
+            )
+            print(
+                f"nonfinite update at step {at_step} discarded "
+                f"(total {res.bad_steps}, {bad_run} consecutive)",
+                file=sys.stderr,
+            )
+            return guard_halt or (0 < max_consec <= bad_run)
 
         def pending_signal() -> int:
             return int(sig_flag["sig"]) if sig_flag and "sig" in sig_flag else 0
@@ -726,20 +798,40 @@ class Trainer:
         stop_sig = 0
         try:
             for epoch in range(cfg.train.epochs):
-                for batch, arrays in self._coordinated_batches(path):
+                # quarantine on the FIRST pass only: later epochs see the
+                # same bad rows again (still counted/enforced), and one
+                # record per bad row beats epochs× duplicates
+                for batch, arrays in self._coordinated_batches(
+                    path, quarantine=epoch == 0
+                ):
                     arrays = self._resolve_fullshard_overflow(batch, arrays)
                     arrays = self._shard_batch(arrays)
                     self.state, m = self.train_step(self.state, arrays)
                     last_metrics = m
                     res.steps += 1
                     res.examples += batch.num_rows
+                    # consume the PREVIOUS step's flag now that this
+                    # step is dispatched — its device time hides the
+                    # host read, so the guard adds no pipeline bubble
+                    if check_pending():
+                        halted = True
+                        break
+                    if self._guarded:
+                        pending_ok = (m, res.steps)
                     if cfg.train.log_every and res.steps % cfg.train.log_every == 0:
-                        res.last_loss = float(m["loss"])
+                        loss = float(m["loss"])
+                        # under the guard a bad step's NaN loss belongs to a
+                        # DISCARDED update: last_loss tracks the last loss
+                        # that actually trained in, and the JSONL record
+                        # stays strict-JSON (None, not a bare NaN literal)
+                        finite = loss == loss and abs(loss) != float("inf")
+                        if finite or not self._guarded:
+                            res.last_loss = loss
                         self.metrics.log(
                             {
                                 "step": res.steps,
                                 "epoch": epoch,
-                                "loss": res.last_loss,
+                                "loss": loss if finite else None,
                                 "examples": res.examples,
                                 "elapsed_s": round(time.time() - start, 3),
                             }
@@ -754,6 +846,8 @@ class Trainer:
                         stop_sig = coordinated_signal()
                         if stop_sig:
                             break
+                if halted:
+                    break
                 res.epochs = epoch + (0 if stop_sig else 1)
                 if not stop_sig:
                     if (epoch + 1) % 30 == 0:
@@ -774,8 +868,40 @@ class Trainer:
                         file=sys.stderr,
                     )
                     break
+            # the last step's flag is still pending after the data ends
+            if not halted and check_pending():
+                halted = True
+            if halted:
+                self.metrics.log(
+                    {
+                        "nonfinite_halt": True,
+                        "step": res.steps,
+                        "bad_steps": res.bad_steps,
+                    }
+                )
+                if cfg.train.checkpoint_dir:
+                    # the bad updates were discarded on device, so the
+                    # live state IS the last good state — commit it
+                    # before aborting, like the preemption path
+                    self.save_checkpoint()
+                raise NonFiniteHalt(
+                    f"non-finite guard aborted at step {res.steps}: "
+                    f"{res.bad_steps} bad step(s), {bad_run} consecutive "
+                    f"(train.nonfinite_guard={cfg.train.nonfinite_guard}, "
+                    f"train.nonfinite_max_consecutive={max_consec})"
+                    + (
+                        f"; last good state committed under "
+                        f"{cfg.train.checkpoint_dir!r}"
+                        if cfg.train.checkpoint_dir
+                        else ""
+                    )
+                )
             if last_metrics is not None:
-                res.last_loss = float(last_metrics["loss"])
+                loss = float(last_metrics["loss"])
+                # a discarded final step keeps the last GOOD loss (the
+                # state never took the bad update)
+                if (loss == loss and abs(loss) != float("inf")) or not self._guarded:
+                    res.last_loss = loss
         finally:
             sig_restore()
             if cfg.train.profile_dir:
@@ -857,7 +983,8 @@ class Trainer:
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
         for batch, arrays in self._coordinated_batches(
-            path, with_plan=self._mesh_engine != "replicated"
+            path, with_plan=self._mesh_engine != "replicated",
+            enforce_bad_rows=False, quarantine=False,
         ):
             arrays = self._resolve_fullshard_overflow(batch, arrays)
             arrays = self._shard_batch(arrays)
@@ -910,7 +1037,8 @@ class Trainer:
         ll_sum, n_rows = 0.0, 0.0
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         for batch, arrays in self._coordinated_batches(
-            path, with_plan=self._mesh_engine != "replicated"
+            path, with_plan=self._mesh_engine != "replicated",
+            enforce_bad_rows=False, quarantine=False,
         ):
             arrays = self._resolve_fullshard_overflow(batch, arrays)
             arrays = self._shard_batch(arrays)
@@ -960,6 +1088,13 @@ class Trainer:
             ckpt.save_orbax(self.cfg.train.checkpoint_dir, self.state)
         else:
             ckpt.save(self.cfg.train.checkpoint_dir, self.state, self._logical_widths())
+        # retention + stale-uncommitted sweep AFTER the commit: the save
+        # that just landed proves no writer owns the swept debris
+        ckpt.prune_checkpoints(
+            self.cfg.train.checkpoint_dir,
+            self.cfg.train.keep_checkpoints,
+            fmt=self.cfg.train.checkpoint_format,
+        )
 
     def _logical_widths(self) -> dict:
         """{table: K} logical row widths, for unpacking packed storage."""
@@ -984,14 +1119,16 @@ class Trainer:
         if not (self.cfg.train.checkpoint_dir and self.cfg.train.resume):
             return False
         cdir = self.cfg.train.checkpoint_dir
-        if self.cfg.train.checkpoint_format == "orbax":
-            if ckpt.latest_orbax_step(cdir) is None:
-                return False
-            self.state = ckpt.restore_orbax(cdir, self.state)
-        else:
-            if ckpt.latest_step(cdir) is None:
-                return False
-            self.state = ckpt.restore(cdir, self.state)
+        fmt = self.cfg.train.checkpoint_format
+        # self-healing restore: the newest checkpoint failing to load
+        # (truncated npz, corrupt orbax shard) walks back to the previous
+        # committed step instead of killing the resume (restore_any logs
+        # what it skipped and why). No checkpoint at all = fresh start;
+        # raises only when checkpoints exist and NONE loads.
+        try:
+            self.state, _ = ckpt.restore_any(cdir, self.state, fmt=fmt)
+        except FileNotFoundError:
+            return False
         return True
 
 
