@@ -94,6 +94,9 @@ def bench_e2e(args) -> int:
                 "value": round(rate, 1),
                 "unit": "examples/sec",
                 "vs_baseline": round(rate / PER_CHIP_TARGET, 3),
+                # wall clock for trajectory correlation only; every
+                # duration above comes from time.perf_counter()
+                "ts": round(time.time(), 3),
             }
         )
     )
@@ -479,6 +482,9 @@ def main() -> int:
             record["e2e_fm_vs_baseline"] = round(e2e_rate / PER_CHIP_TARGET, 3)
     if kernel_parity is not None:
         record["kernel_parity"] = kernel_parity
+    # wall clock for trajectory correlation only; all durations above are
+    # time.perf_counter() (monotonic — wall clock jumps under NTP slew)
+    record["ts"] = round(time.time(), 3)
     print(json.dumps(record))
     return 0
 

@@ -406,7 +406,9 @@ def test_metrics_logger_reopens_after_close(tmp_path):
     ml.log({"b": 2})  # reopens in append mode
     ml.close()
     recs = [json.loads(l) for l in open(path)]
-    assert recs == [{"a": 1}, {"b": 2}]
+    assert len(recs) == 2 and recs[0]["a"] == 1 and recs[1]["b"] == 2
+    # both appends carry the provenance stamp (PR 2: joinable streams)
+    assert all("ts" in r and "rank" in r and "run_id" in r for r in recs)
 
 
 # ------------------------------------------------------------- killed rank

@@ -159,7 +159,8 @@ def test_launch_local_two_process_matches_single_process(tmp_path):
     )
 
     r2 = run_cli(
-        ["launch-local", "--num-processes", "2", "--",
+        ["launch-local", "--num-processes", "2",
+         "--run-dir", str(tmp_path / "run2p"), "--",
          "--train", str(tmp_path / "train"), "--test", str(tmp_path / "test"),
          "--batch-size", str(B), "--checkpoint-dir", str(tmp_path / "ckpt2p"),
          # pin EXACT eval: this is the bit-match gate, and the multi-
@@ -170,6 +171,19 @@ def test_launch_local_two_process_matches_single_process(tmp_path):
         tmp_path,
     )
     assert r2.returncode == 0, r2.stderr
+    # --run-dir collected one stamped telemetry stream per rank,
+    # joinable on a single shared run_id
+    telem = {}
+    for rank in (0, 1):
+        recs = [
+            json.loads(l)
+            for l in open(tmp_path / "run2p" / f"metrics_rank{rank}.jsonl")
+        ]
+        assert recs and all(r["rank"] == rank for r in recs)
+        telem[rank] = recs
+    assert {r["run_id"] for rs in telem.values() for r in rs} == {
+        telem[0][0]["run_id"]
+    }
     # exactly one summary line: rank 0's (the round-1 bug printed two)
     summaries = [json.loads(l) for l in r2.stdout.strip().splitlines() if l.startswith("{")]
     assert len(summaries) == 1, r2.stdout

@@ -1,0 +1,520 @@
+"""Telemetry subsystem tests (xflow_tpu/telemetry.py, jsonl.py,
+tools/metrics_report.py, tools/smoke_telemetry.sh): registry semantics,
+StepTimer decomposition, trace windows, record stamping, the
+truncation-tolerant reader, and the report tool's summary/check paths.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.data.synth import generate_shards
+from xflow_tpu.jsonl import JsonlAppender, read_jsonl, read_jsonl_counted
+from xflow_tpu.telemetry import (
+    Registry,
+    StepTimer,
+    TraceWindow,
+    default_registry,
+    resolve_run_id,
+)
+from xflow_tpu.train.trainer import Trainer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counter_semantics():
+    r = Registry()
+    c = r.counter("n")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert r.counter("n") is c  # create-or-get
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotone
+
+
+def test_gauge_semantics():
+    r = Registry()
+    g = r.gauge("depth")
+    g.set(3)
+    g.set(1.5)
+    assert g.value == 1.5
+
+
+def test_timer_window_percentiles():
+    r = Registry()
+    t = r.timer("lat")
+    for ms in (1, 2, 3, 4, 100):
+        t.observe(ms / 1e3)
+    assert t.count == 5
+    assert t.total_s == pytest.approx(0.110)
+    assert t.percentile(50) == pytest.approx(0.003)
+    assert t.percentile(99) == pytest.approx(0.100, rel=0.05)
+    window = t.window_reset()
+    assert len(window) == 5
+    # window cleared, totals survive
+    assert np.isnan(t.percentile(50))
+    assert t.count == 5
+    with t.timing():
+        time.sleep(0.01)
+    assert t.count == 6 and t.percentile(50) >= 0.01
+
+
+def test_registry_kind_clash_and_snapshot():
+    r = Registry()
+    r.counter("x").inc(2)
+    r.gauge("y").set(7)
+    r.timer("z").observe(0.5)
+    with pytest.raises(TypeError):
+        r.gauge("x")
+    snap = r.snapshot()
+    assert snap["x"] == 2 and snap["y"] == 7
+    assert snap["z.count"] == 1 and snap["z.total_s"] == pytest.approx(0.5)
+    r.reset()
+    assert r.snapshot() == {}
+
+
+def test_default_registry_is_shared():
+    assert default_registry() is default_registry()
+
+
+# ----------------------------------------------------------------- StepTimer
+
+
+def test_step_timer_decomposition_synthetic():
+    """30 synthetic steps with known host-side sleeps: every field
+    present, steps counted, per-step sum components sane, and the
+    step-time total telescopes to the elapsed wall time."""
+    st = StepTimer(registry=Registry())
+
+    def feed():
+        for i in range(30):
+            time.sleep(0.002)  # data wait, inside next()
+            yield i
+
+    t0 = time.perf_counter()
+    for _ in st.batches(feed()):
+        time.sleep(0.001)  # "dispatch"
+        st.dispatched({"loss": np.float32(0.5)}, rows=64)
+    st.flush()
+    elapsed = time.perf_counter() - t0
+    assert st.steps == 30
+    assert st.rows == 30 * 64
+    rec = st.window_record()
+    for key in ("steps_per_s", "rows_per_s", "step_time_p50_ms",
+                "step_time_p99_ms", "data_wait_ms", "dispatch_ms", "device_ms"):
+        assert key in rec, key
+    assert rec["data_wait_ms"] >= 2.0  # the sleep inside next()
+    assert rec["dispatch_ms"] >= 1.0  # the sleep before dispatched()
+    assert rec["step_time_p99_ms"] >= rec["step_time_p50_ms"] > 0
+    # completion intervals telescope: their sum is the run's elapsed time
+    assert st.steps / max(rec["steps_per_s"], 1e-9) == pytest.approx(
+        elapsed, rel=0.25
+    )
+    # window consumed
+    assert st.window_record() == {}
+
+
+def test_step_timer_sum_matches_elapsed():
+    st = StepTimer(registry=Registry())
+    reg = st._reg
+    t0 = time.perf_counter()
+    for _ in st.batches(iter(range(10))):
+        time.sleep(0.003)
+        st.dispatched({"loss": 0.0}, rows=1)
+    st.flush()
+    elapsed = time.perf_counter() - t0
+    assert reg.timer("step.time").count == 10
+    assert reg.timer("step.time").total_s == pytest.approx(elapsed, rel=0.2)
+
+
+def test_step_timer_closes_abandoned_iterator():
+    closed = {}
+
+    def feed():
+        try:
+            while True:
+                yield 0
+        finally:
+            closed["yes"] = True
+
+    st = StepTimer(registry=Registry())
+    for i, _ in enumerate(st.batches(feed())):
+        st.dispatched({}, rows=1)
+        if i == 2:
+            break
+    import gc
+
+    gc.collect()
+    assert closed.get("yes"), "abandoned inner iterator was not closed"
+
+
+# --------------------------------------------------------------- TraceWindow
+
+
+class FakeProfiler:
+    def __init__(self):
+        self.events = []
+
+    def start_trace(self, d):
+        self.events.append(("start", d))
+
+    def stop_trace(self):
+        self.events.append(("stop", None))
+
+
+def test_trace_window_respects_step_range():
+    prof = FakeProfiler()
+    tw = TraceWindow("dir", start_step=5, num_steps=3, profiler=prof)
+    tw.maybe_start_run()
+    assert prof.events == []  # window mode: nothing pre-loop
+    for step in range(1, 13):
+        tw.before_step(step)
+        if step < 5:
+            assert prof.events == [], f"started early at step {step}"
+    tw.close()
+    assert prof.events == [("start", "dir"), ("stop", None)]
+    # stop fired when step 8 dispatched (5,6,7 traced), not at close
+    tw2 = TraceWindow("dir", 5, 3, profiler=FakeProfiler())
+    for step in range(1, 8):
+        tw2.before_step(step)
+    assert tw2._running  # step 8 never dispatched
+    tw2.close()
+    assert not tw2._running
+
+
+def test_trace_window_whole_run_mode():
+    prof = FakeProfiler()
+    tw = TraceWindow("dir", start_step=0, profiler=prof)
+    tw.maybe_start_run()
+    for step in range(1, 5):
+        tw.before_step(step)
+    tw.close()
+    assert prof.events == [("start", "dir"), ("stop", None)]
+
+
+def test_trace_window_disabled_without_dir():
+    tw = TraceWindow("", start_step=5, num_steps=3, profiler=FakeProfiler())
+    tw.maybe_start_run()
+    tw.before_step(5)
+    tw.close()
+    assert tw._prof.events == []
+
+
+# --------------------------------------------------- trainer integration
+
+
+def _train_cfg(tmp_path, **kw):
+    base = {
+        "data.train_path": str(tmp_path / "train"),
+        "data.log2_slots": 12,
+        "data.batch_size": 64,
+        "data.max_nnz": 8,
+        "model.num_fields": 6,
+        "train.epochs": 1,
+        "train.log_every": 10,
+        "train.pred_dump": False,
+    }
+    base.update(kw)
+    return override(Config(), **base)
+
+
+@pytest.fixture
+def train_data(tmp_path):
+    generate_shards(
+        str(tmp_path / "train"), 1, 1920, num_fields=6, ids_per_field=40, seed=0
+    )
+    return tmp_path
+
+
+def test_trainer_emits_stamped_window_records(train_data, tmp_path, monkeypatch):
+    """Acceptance gate: every record carries ts/rank/run_id; log-window
+    records carry the full step decomposition; steps monotone; step-time
+    totals ≈ the run's elapsed seconds."""
+    monkeypatch.chdir(tmp_path)
+    mpath = tmp_path / "run" / "metrics_rank0.jsonl"
+    cfg = _train_cfg(train_data, **{"train.metrics_path": str(mpath)})
+    # the default registry holds PROCESS totals — clear what earlier
+    # tests in this pytest process accumulated so counts are exact
+    default_registry().reset()
+    res = Trainer(cfg).fit()
+    assert res.steps == 30
+    recs = read_jsonl(str(mpath))
+    assert recs
+    for r in recs:
+        assert "ts" in r and "rank" in r and "run_id" in r
+        assert r["rank"] == 0
+    assert len({r["run_id"] for r in recs}) == 1
+    windows = [r for r in recs if "rows_per_s" in r]
+    assert windows, "no window records"
+    for w in windows:
+        for key in ("rows_per_s", "steps_per_s", "step_time_p50_ms",
+                    "step_time_p99_ms", "data_wait_ms", "dispatch_ms",
+                    "device_ms"):
+            assert key in w, key
+        assert w["rows_per_s"] > 0
+        assert w["step_time_p99_ms"] >= w["step_time_p50_ms"] > 0
+    steps = [r["step"] for r in recs if "step" in r]
+    assert steps == sorted(steps)
+    # pipeline counters rode along and the step-time totals telescope
+    final = next(r for r in recs if r.get("final"))
+    counters = final["counters"]
+    assert counters["data.batches"] == 30
+    assert counters["data.rows"] == 1920
+    assert counters["step.time.count"] == 30
+    assert counters["step.time.total_s"] == pytest.approx(res.seconds, rel=0.2)
+
+
+def test_trainer_trace_window_mid_run(train_data, tmp_path, monkeypatch):
+    """Programmatic window: profile dir non-empty, and the profiler was
+    started/stopped exactly once at the configured steps."""
+    monkeypatch.chdir(tmp_path)
+    import glob
+
+    import jax
+
+    calls = []
+    real_start, real_stop = jax.profiler.start_trace, jax.profiler.stop_trace
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda d: (calls.append("start"), real_start(d))[1],
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: (calls.append("stop"), real_stop())[1]
+    )
+    cfg = _train_cfg(
+        train_data,
+        **{
+            "train.profile_dir": str(tmp_path / "prof"),
+            "train.trace_start_step": 5,
+            "train.trace_num_steps": 5,
+        },
+    )
+    Trainer(cfg).fit()
+    assert calls == ["start", "stop"]
+    traces = glob.glob(str(tmp_path / "prof" / "**" / "*"), recursive=True)
+    assert traces, "trace window produced no profiler output"
+
+
+def test_quarantine_records_are_stamped(tmp_path):
+    """Quarantine and metrics streams must be joinable: both stamped
+    with ts/rank/run_id by the shared appender."""
+    from xflow_tpu.data.pipeline import batch_iterator
+    from xflow_tpu.testing.faults import write_malformed_libffm
+
+    shard = tmp_path / "junk-00000"
+    info = write_malformed_libffm(str(shard), n_good=30, n_bad=4, seed=1)
+    qpath = tmp_path / "quarantine.jsonl"
+    cfg = override(
+        Config(),
+        **{
+            "data.batch_size": 16,
+            "data.max_bad_rows": 100,
+            "data.quarantine_path": str(qpath),
+            "data.log2_slots": 12,
+            "data.max_nnz": 8,
+        },
+    ).data
+    list(batch_iterator(str(shard), cfg))
+    recs = read_jsonl(str(qpath))
+    assert len(recs) == info["bad"]
+    for r in recs:
+        assert "ts" in r and "rank" in r and "run_id" in r
+        assert r["source"] == str(shard)
+    # same process → same run id as any other sink would stamp
+    assert recs[0]["run_id"] == resolve_run_id()
+
+
+# ------------------------------------------------------- tolerant reader
+
+
+def test_read_jsonl_skips_truncated_tail(tmp_path, capsys):
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"step": 1}) + "\n")
+        f.write(json.dumps({"step": 2}) + "\n")
+        f.write('{"step": 3, "loss": 0.4')  # crash mid-append
+    recs, skipped = read_jsonl_counted(str(p))
+    assert [r["step"] for r in recs] == [1, 2]
+    assert skipped == 1
+    assert "skipped 1 unparseable" in capsys.readouterr().err
+
+
+def test_read_jsonl_skips_mid_file_garbage(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"step": 1}) + "\n")
+        f.write("not json at all\n")
+        f.write('[1, 2]\n')  # parseable but not a record
+        f.write(json.dumps({"step": 2}) + "\n")
+    recs, skipped = read_jsonl_counted(str(p), warn=False)
+    assert [r["step"] for r in recs] == [1, 2]
+    assert skipped == 2
+
+
+def test_appender_stamps_and_reopens(tmp_path):
+    p = tmp_path / "a.jsonl"
+    a = JsonlAppender(str(p), stamp={"rank": 3, "run_id": "r1"})
+    a.append({"x": 1})
+    a.close()
+    a.append({"x": 2})  # transparent reopen
+    a.close()
+    recs = read_jsonl(str(p))
+    assert [r["x"] for r in recs] == [1, 2]
+    assert all(r["rank"] == 3 and r["run_id"] == "r1" and "ts" in r for r in recs)
+
+
+# -------------------------------------------------------- metrics_report
+
+
+def _report(args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "metrics_report.py"),
+         *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+@pytest.fixture
+def run_jsonl(train_data, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mpath = tmp_path / "run" / "metrics_rank0.jsonl"
+    cfg = _train_cfg(train_data, **{"train.metrics_path": str(mpath)})
+    Trainer(cfg).fit()
+    return mpath
+
+
+def test_metrics_report_summary_and_check(run_jsonl, tmp_path):
+    r = _report([str(run_jsonl.parent), "--check"])
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+    r = _report([str(run_jsonl.parent)])
+    assert r.returncode == 0, r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].split() == [
+        "run_id", "rank", "steps", "examples", "elapsed_s", "ex/s", "rows/s",
+        "p50_ms", "p99_ms", "wait_ms", "loss", "bad_steps", "bad_rows", "auc",
+    ]
+    row = lines[2].split()
+    assert row[1] == "0" and row[2] == "30" and row[3] == "1920"
+
+
+def test_metrics_report_tolerates_truncation(run_jsonl, tmp_path):
+    data = run_jsonl.read_bytes()
+    trunc = tmp_path / "trunc" / "metrics_rank0.jsonl"
+    trunc.parent.mkdir()
+    trunc.write_bytes(data[:-30])  # cut inside the final record
+    r = _report([str(trunc)])
+    assert r.returncode == 0, r.stderr
+    assert "damaged line(s) skipped" in r.stdout
+    assert "skipped 1 unparseable" in r.stderr
+    r = _report([str(trunc), "--check"])
+    assert r.returncode == 0, r.stderr  # damage is skipped, schema still OK
+
+
+def test_metrics_report_bench_json(run_jsonl, tmp_path):
+    out = tmp_path / "bench.json"
+    r = _report([str(run_jsonl), "--bench-json", str(out)])
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "telemetry_examples_per_sec"
+    assert rec["unit"] == "examples/sec"
+    assert rec["value"] > 0
+    assert rec["steps"] == 30 and rec["examples"] == 1920 and rec["ranks"] == 1
+
+
+def test_metrics_report_check_flags_bad_schema(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    with open(bad, "w") as f:
+        # unstamped record + backwards step
+        f.write(json.dumps({"step": 5, "loss": 0.1}) + "\n")
+        f.write(
+            json.dumps(
+                {"ts": 1.0, "rank": 0, "run_id": "r", "step": 3, "loss": 0.1}
+            )
+            + "\n"
+        )
+    r = _report([str(bad), "--check"])
+    assert r.returncode != 0
+    assert "FAIL" in r.stderr
+
+
+def test_metrics_report_empty_dir(tmp_path):
+    r = _report([str(tmp_path)])
+    assert r.returncode != 0
+
+
+# --------------------------------------------------------------- smoke gate
+
+
+def test_smoke_telemetry_script(tmp_path):
+    """tools/smoke_telemetry.sh: 50-step synthetic train + schema gate,
+    runnable standalone and from CI."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        ["bash", os.path.join(REPO_ROOT, "tools", "smoke_telemetry.sh"),
+         str(tmp_path / "work")],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        env=env,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "metrics_report: OK" in r.stdout
+    assert "smoke_telemetry: OK" in r.stdout
+
+
+# ------------------------------------------------------------ launch wiring
+
+
+def test_launch_dist_run_dir_dry_run(tmp_path):
+    """--run-dir threads per-rank metrics paths and a shared run id into
+    every rank's command line (checked via --dry-run: no ssh runs)."""
+    from xflow_tpu.launch.cli import main
+
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("h0\nh1\n")
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(
+            ["launch-dist", "--hosts", str(hosts), "--dry-run",
+             "--run-dir", "/runs/exp1", "--",
+             "--train", "/data/train", "--model", "lr"]
+        )
+    out = buf.getvalue()
+    assert rc == 0
+    assert "metrics_rank0.jsonl" in out and "metrics_rank1.jsonl" in out
+    assert out.count("XFLOW_RUN_ID=") == 2
+    # both ranks share the SAME id
+    ids = {
+        tok.split("=", 1)[1].strip("'\"")
+        for line in out.splitlines()
+        for tok in line.split()
+        if tok.startswith("XFLOW_RUN_ID=")
+    }
+    assert len(ids) == 1
+
+
+def test_launch_local_rank_metrics_args(tmp_path):
+    from xflow_tpu.launch.local import rank_metrics_args
+
+    assert rank_metrics_args("", 0) == []
+    args = rank_metrics_args(str(tmp_path / "run"), 3)
+    assert args[0] == "--set"
+    assert args[1].endswith("metrics_rank3.jsonl")

@@ -1,0 +1,301 @@
+#!/usr/bin/env python3
+"""Summarize xflow telemetry JSONL runs (docs/OBSERVABILITY.md).
+
+Loads one or more metrics JSONL files (or run directories — every
+`*.jsonl` inside), groups records by (run_id, rank), and prints a
+throughput / loss / bad-step summary table. Reading is
+truncation-tolerant (xflow_tpu.jsonl.read_jsonl_counted): a crash
+mid-append leaves a partial last line, which is skipped with a warning,
+never an exception.
+
+    python tools/metrics_report.py runs/exp1/               # summary table
+    python tools/metrics_report.py a.jsonl b.jsonl          # multiple files
+    python tools/metrics_report.py runs/exp1 --check        # schema gate (CI)
+    python tools/metrics_report.py runs/exp1 --bench-json - # BENCH-style JSON
+
+`--check` validates the telemetry schema — every record stamped with
+ts/rank/run_id, step numbers monotone per stream, window records
+carrying the full decomposition key set — and exits nonzero on any
+violation (tools/smoke_telemetry.sh gates on it).
+
+`--bench-json` emits a BENCH-style perf-trajectory record (the shape
+bench.py prints) computed from the run's own telemetry, so a training
+run doubles as a benchmark sample without a separate bench invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xflow_tpu.jsonl import read_jsonl_counted  # noqa: E402
+
+# the step-decomposition keys every window record carries (telemetry
+# .StepTimer.window_record); --check enforces all-or-none
+WINDOW_KEYS = (
+    "steps_per_s",
+    "rows_per_s",
+    "step_time_p50_ms",
+    "step_time_p99_ms",
+    "data_wait_ms",
+    "dispatch_ms",
+    "device_ms",
+)
+STAMP_KEYS = ("ts", "rank", "run_id")
+
+
+def expand_paths(paths: list[str]) -> list[str]:
+    """Files stay files; directories expand to their sorted *.jsonl."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            if not found:
+                raise FileNotFoundError(f"{p!r}: directory holds no *.jsonl files")
+            out.extend(found)
+        elif not os.path.exists(p):
+            # caught in main(): a clean message + exit 2, not a traceback
+            raise FileNotFoundError(f"{p!r}: no such file")
+        else:
+            out.append(p)
+    return out
+
+
+def load_streams(files: list[str]) -> tuple[dict, int]:
+    """{(run_id, rank): [records in file order]} across all files, plus
+    the total damaged-line count."""
+    streams: dict = {}
+    skipped_total = 0
+    for path in files:
+        records, skipped = read_jsonl_counted(path)
+        skipped_total += skipped
+        for rec in records:
+            key = (str(rec.get("run_id", "?")), rec.get("rank", "?"))
+            streams.setdefault(key, []).append(rec)
+    return streams, skipped_total
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def summarize_stream(records: list[dict]) -> dict:
+    """One summary row for a (run_id, rank) stream."""
+    steps_recs = [r for r in records if "step" in r and "loss" in r]
+    windows = [r for r in records if "rows_per_s" in r]
+    counters = [r["counters"] for r in records if isinstance(r.get("counters"), dict)]
+    final = next((r for r in records if r.get("final")), None)
+
+    steps = max(
+        [r["step"] for r in steps_recs if _finite(r.get("step"))]
+        + ([final["steps"]] if final and _finite(final.get("steps")) else [0])
+        or [0]
+    )
+    examples = max(
+        (r["examples"] for r in records if _finite(r.get("examples"))), default=0
+    )
+    elapsed = max(
+        (r["elapsed_s"] for r in records if _finite(r.get("elapsed_s"))), default=0.0
+    )
+    losses = [r["loss"] for r in steps_recs if _finite(r.get("loss"))]
+    p50s = [r["step_time_p50_ms"] for r in windows if _finite(r.get("step_time_p50_ms"))]
+    p99s = [r["step_time_p99_ms"] for r in windows if _finite(r.get("step_time_p99_ms"))]
+    waits = [r["data_wait_ms"] for r in windows if _finite(r.get("data_wait_ms"))]
+    rates = [r["rows_per_s"] for r in windows if _finite(r.get("rows_per_s"))]
+    evals = [r["eval_auc"] for r in records if _finite(r.get("eval_auc"))]
+    bad_steps = max(
+        (r["bad_steps"] for r in records if _finite(r.get("bad_steps"))), default=0
+    )
+    bad_rows = max((c.get("data.bad_rows", 0) for c in counters), default=0)
+
+    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else float("nan")
+    return {
+        "steps": int(steps),
+        "examples": int(examples),
+        "elapsed_s": float(elapsed),
+        "examples_per_s": examples / elapsed if elapsed > 0 else float("nan"),
+        "rows_per_s": med(rates),
+        "p50_ms": med(p50s),
+        "p99_ms": max(p99s) if p99s else float("nan"),
+        "data_wait_ms": sum(waits) / len(waits) if waits else float("nan"),
+        "last_loss": losses[-1] if losses else float("nan"),
+        "bad_steps": int(bad_steps),
+        "bad_rows": int(bad_rows),
+        "eval_auc": evals[-1] if evals else float("nan"),
+        "windows": len(windows),
+    }
+
+
+def check_streams(streams: dict, files: list[str]) -> list[str]:
+    """Schema violations ([] = clean). The contract checked here is the
+    one docs/OBSERVABILITY.md documents — keep the three in sync."""
+    problems: list[str] = []
+    if not streams:
+        problems.append(f"no records in {', '.join(files)}")
+    for (run_id, rank), records in sorted(streams.items(), key=str):
+        tag = f"run {run_id} rank {rank}"
+        last_step = -1
+        step_recs = 0
+        window_recs = 0
+        for i, rec in enumerate(records, 1):
+            for key in STAMP_KEYS:
+                if key not in rec:
+                    problems.append(f"{tag}: record {i} lacks {key!r}")
+            if not _finite(rec.get("ts", 0.0)):
+                problems.append(f"{tag}: record {i} has non-numeric ts")
+            if "step" in rec:
+                step_recs += 1
+                if _finite(rec["step"]):
+                    if rec["step"] < last_step:
+                        problems.append(
+                            f"{tag}: step went backwards "
+                            f"({last_step} -> {rec['step']}) at record {i}"
+                        )
+                    last_step = max(last_step, rec["step"])
+            present = [k for k in WINDOW_KEYS if k in rec]
+            if present:
+                window_recs += 1
+                missing = [k for k in WINDOW_KEYS if k not in rec]
+                if missing:
+                    problems.append(
+                        f"{tag}: record {i} has window keys {present} but "
+                        f"lacks {missing}"
+                    )
+        if step_recs >= 2 and window_recs == 0:
+            problems.append(
+                f"{tag}: {step_recs} step records but no window record — "
+                "StepTimer stats never landed"
+            )
+    return problems
+
+
+def render_table(rows: list[tuple]) -> str:
+    header = (
+        "run_id", "rank", "steps", "examples", "elapsed_s", "ex/s",
+        "rows/s", "p50_ms", "p99_ms", "wait_ms", "loss", "bad_steps",
+        "bad_rows", "auc",
+    )
+
+    def fmt(v) -> str:
+        if isinstance(v, float):
+            if not math.isfinite(v):
+                return "-"
+            return f"{v:.4g}" if abs(v) < 1000 else f"{v:,.0f}"
+        return str(v)
+
+    cells = [header] + [tuple(fmt(c) for c in row) for row in rows]
+    widths = [max(len(r[i]) for r in cells) for i in range(len(header))]
+    lines = []
+    for i, row in enumerate(cells):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def bench_record(streams: dict) -> dict:
+    """BENCH-style perf record over the newest run: summed per-rank
+    examples over the longest rank elapsed — the honest cross-rank
+    aggregate (ranks run the same global steps; examples counters are
+    per-rank local rows)."""
+    if not streams:
+        return {}
+    # newest run = the one whose records carry the largest ts
+    def run_ts(run_id: str) -> float:
+        return max(
+            (r.get("ts", 0.0) for (rid, _), recs in streams.items() if rid == run_id
+             for r in recs if _finite(r.get("ts"))),
+            default=0.0,
+        )
+
+    run_ids = {rid for rid, _ in streams}
+    newest = max(run_ids, key=run_ts)
+    rows = {
+        rank: summarize_stream(recs)
+        for (rid, rank), recs in streams.items()
+        if rid == newest
+    }
+    examples = sum(s["examples"] for s in rows.values())
+    elapsed = max((s["elapsed_s"] for s in rows.values()), default=0.0)
+    steps = max((s["steps"] for s in rows.values()), default=0)
+    value = examples / elapsed if elapsed > 0 else 0.0
+    return {
+        "metric": "telemetry_examples_per_sec",
+        "value": round(value, 1),
+        "unit": "examples/sec",
+        "run_id": newest,
+        "ranks": len(rows),
+        "steps": int(steps),
+        "examples": int(examples),
+        "elapsed_s": round(elapsed, 3),
+        "bad_steps": int(sum(s["bad_steps"] for s in rows.values())),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize / schema-check xflow telemetry JSONL runs"
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL file(s) and/or run dir(s)")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate and exit nonzero on violation")
+    ap.add_argument("--bench-json", default="",
+                    help="write a BENCH-style perf JSON here ('-' = stdout)")
+    args = ap.parse_args(argv)
+
+    try:
+        files = expand_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"metrics_report: {e}", file=sys.stderr)
+        return 2
+    streams, skipped = load_streams(files)
+
+    if args.check:
+        problems = check_streams(streams, files)
+        if problems:
+            for p in problems:
+                print(f"metrics_report: FAIL: {p}", file=sys.stderr)
+            return 2
+        total = sum(len(v) for v in streams.values())
+        print(
+            f"metrics_report: OK: {len(files)} file(s), {len(streams)} "
+            f"stream(s), {total} record(s), {skipped} damaged line(s) skipped"
+        )
+        return 0
+
+    rows = []
+    for (run_id, rank), records in sorted(streams.items(), key=str):
+        s = summarize_stream(records)
+        rows.append((
+            run_id, rank, s["steps"], s["examples"], round(s["elapsed_s"], 1),
+            s["examples_per_s"], s["rows_per_s"], s["p50_ms"], s["p99_ms"],
+            s["data_wait_ms"], s["last_loss"], s["bad_steps"], s["bad_rows"],
+            s["eval_auc"],
+        ))
+    if rows:
+        print(render_table(rows))
+    else:
+        print("metrics_report: no records found", file=sys.stderr)
+        return 1
+    if skipped:
+        print(f"# {skipped} damaged line(s) skipped (truncated append?)")
+
+    if args.bench_json:
+        rec = bench_record(streams)
+        out = json.dumps(rec)
+        if args.bench_json == "-":
+            print(out)
+        else:
+            with open(args.bench_json, "w") as f:
+                f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

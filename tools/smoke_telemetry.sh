@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Telemetry smoke gate (docs/OBSERVABILITY.md): a 50-step synthetic CPU
+# train with the metrics JSONL on, then a schema validation of what it
+# emitted via tools/metrics_report.py --check, then the human summary.
+#
+# Standalone:    bash tools/smoke_telemetry.sh [workdir]
+# From pytest:   tests/test_telemetry.py::test_smoke_telemetry_script
+#
+# With no workdir argument a temp dir is created and cleaned up.
+set -eu
+cd "$(dirname "$0")/.."
+
+WORK="${1:-}"
+if [ -z "$WORK" ]; then
+    WORK="$(mktemp -d)"
+    trap 'rm -rf "$WORK"' EXIT
+fi
+
+export JAX_PLATFORMS=cpu
+
+# 3200 rows / batch 64 = 50 steps
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+
+python -m xflow_tpu train \
+    --train "$WORK/train" --model lr --epochs 1 \
+    --batch-size 64 --log2-slots 12 --no-mesh \
+    --set model.num_fields=6 \
+    --set data.max_nnz=8 \
+    --set train.pred_dump=false \
+    --set train.log_every=10 \
+    --set "train.metrics_path=$WORK/run/metrics_rank0.jsonl" \
+    >/dev/null
+
+python tools/metrics_report.py "$WORK/run" --check
+python tools/metrics_report.py "$WORK/run"
+echo "smoke_telemetry: OK"

@@ -268,6 +268,13 @@ class TrainConfig:
     eval_buckets: int = -1
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
     profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
+    # programmatic trace window (telemetry.TraceWindow): with profile_dir
+    # set and trace_start_step >= 1, the xprof trace starts just before
+    # that step's dispatch — after compilation settles, so the window
+    # shows the steady state instead of compile noise — and stops once
+    # trace_num_steps steps have dispatched. 0 = legacy whole-run trace.
+    trace_start_step: int = 0
+    trace_num_steps: int = 20
     # preemption: on SIGTERM/SIGINT save a checkpoint at the next
     # coordination point and return early. Single-process coordinates
     # every step; multi-process runs agree on "stop at step N" through a

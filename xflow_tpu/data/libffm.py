@@ -151,8 +151,11 @@ class QuarantineWriter(JsonlAppender):
     One line per bad row: source path, batch/row position, label — enough
     to locate the offending region of a shard for offline triage without
     re-parsing the whole file. Lifecycle (lazy open with parent-dir
-    creation, flush-per-record, reopen-safe close) comes from the shared
-    appender (xflow_tpu/jsonl.py)."""
+    creation, flush-per-record, reopen-safe close) AND the ts/rank/run_id
+    provenance stamp come from the shared appender (xflow_tpu/jsonl.py),
+    so quarantine records join the metrics stream on (run_id, rank, ts).
+    Written rows also tick the telemetry registry
+    (`data.quarantined_rows`), surfacing in metrics window records."""
 
     def __init__(self, path: str = ""):
         super().__init__(path)
@@ -165,6 +168,9 @@ class QuarantineWriter(JsonlAppender):
             {"source": source, "batch": batch_index, "row": row, "label": label}
         )
         self.written += 1
+        from xflow_tpu.telemetry import default_registry
+
+        default_registry().counter("data.quarantined_rows").inc()
 
 
 def available_shards(prefix: str) -> list[str]:

@@ -73,13 +73,27 @@ def monitor_bad_rows(
     each rank's own shard, so an over-budget shard aborts that rank
     loudly (and the job with it) — a garbage shard is a data bug, not a
     condition to coordinate around."""
+    from xflow_tpu.telemetry import default_registry
+
     budget = cfg.max_bad_rows
     qw = QuarantineWriter(cfg.quarantine_path if quarantine else "")
+    # pipeline counters (telemetry registry): run totals the trainer
+    # snapshots into every metrics-JSONL window record, so batch/row
+    # progress and bad-row counts ride the same stream the step
+    # decomposition does. Incremented HERE (the prefetch thread) —
+    # Counter is lock-protected against the fit loop's snapshot reads.
+    reg = default_registry()
+    c_batches = reg.counter("data.batches")
+    c_rows = reg.counter("data.rows")
+    c_bad = reg.counter("data.bad_rows")
     total = 0
     try:
         for bi, batch in enumerate(batches):
+            c_batches.inc()
+            c_rows.inc(batch.num_rows)
             idx = bad_row_indices(batch)
             if idx.size:
+                c_bad.inc(int(idx.size))
                 labels = np.asarray(batch.labels)
                 for r in idx:
                     qw.write(path, bi, int(r), float(labels[r]))

@@ -1,6 +1,7 @@
-"""Lazy append-mode JSONL sink, shared by the metrics stream
-(train/trainer.py MetricsLogger) and the bad-record quarantine
-(data/libffm.py QuarantineWriter) so the lifecycle mechanics live once.
+"""Lazy append-mode JSONL sink + truncation-tolerant reader, shared by
+the metrics stream (train/trainer.py MetricsLogger) and the bad-record
+quarantine (data/libffm.py QuarantineWriter) so the lifecycle and
+stamping mechanics live once.
 
 Lifecycle: the file opens on the FIRST record (creating the parent
 directory — a path inside a not-yet-existing run dir must not crash the
@@ -8,18 +9,39 @@ construction), every record is flushed (a crash loses nothing already
 appended), and `close()` flushes, closes, and returns the sink to its
 lazy state — a later append transparently reopens in append mode
 instead of writing to a closed handle. An empty path disables the sink
-entirely (every call is a no-op)."""
+entirely (every call is a no-op).
+
+Stamping: every record is prefixed with `ts` (wall-clock seconds —
+correlation only; durations use time.perf_counter), `rank`, and
+`run_id` (xflow_tpu/telemetry.py), so per-rank metrics and quarantine
+streams from one run are joinable and a report tool can group them
+without side-channel knowledge. Callers that know their identity pass
+`stamp=`; sinks constructed deep in the data layer resolve it lazily at
+the first append (by then the launcher env / distributed init has
+settled).
+"""
 
 from __future__ import annotations
 
 import json
 import os
+import sys
+import time
+from typing import Optional
 
 
 class JsonlAppender:
-    def __init__(self, path: str = ""):
+    def __init__(self, path: str = "", stamp: Optional[dict] = None):
         self._path = path
         self._f = None
+        self._static = stamp
+
+    def _stamp(self) -> dict:
+        if self._static is None:
+            from xflow_tpu.telemetry import resolve_rank, resolve_run_id
+
+            self._static = {"rank": resolve_rank(), "run_id": resolve_run_id()}
+        return self._static
 
     def append(self, record: dict) -> None:
         if not self._path:
@@ -29,10 +51,53 @@ class JsonlAppender:
             if parent:
                 os.makedirs(parent, exist_ok=True)
             self._f = open(self._path, "a")
-        self._f.write(json.dumps(record) + "\n")
+        rec = {"ts": round(time.time(), 6), **self._stamp(), **record}
+        self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+
+def read_jsonl_counted(path: str, warn: bool = True) -> tuple[list, int]:
+    """(records, skipped) from a JSONL file, tolerating damage.
+
+    A crash mid-append leaves a partial last line (the appender flushes
+    per record, but the record itself can be cut); a reader that raises
+    on it makes every post-crash report useless. Unparseable lines —
+    final or not — are skipped and counted, with one stderr warning per
+    file, never an exception."""
+    records: list = []
+    skipped = 0
+    first_bad = 0
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                first_bad = first_bad or i
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                first_bad = first_bad or i
+                continue
+            records.append(rec)
+    if skipped and warn:
+        print(
+            f"xflow: warning: {path}: skipped {skipped} unparseable JSONL "
+            f"line(s) (first at line {first_bad}; truncated append or "
+            "corruption)",
+            file=sys.stderr,
+        )
+    return records, skipped
+
+
+def read_jsonl(path: str, warn: bool = True) -> list:
+    """Truncation-tolerant JSONL read (see read_jsonl_counted)."""
+    return read_jsonl_counted(path, warn=warn)[0]

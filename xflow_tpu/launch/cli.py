@@ -173,7 +173,9 @@ def cmd_collisions(args) -> int:
 def cmd_launch_local(args) -> int:
     from xflow_tpu.launch.local import launch_local
 
-    return launch_local(args.num_processes, args.forward, port=args.port)
+    return launch_local(
+        args.num_processes, args.forward, port=args.port, run_dir=args.run_dir
+    )
 
 
 def cmd_launch_dist(args) -> int:
@@ -194,7 +196,7 @@ def cmd_launch_dist(args) -> int:
     return launch_dist(
         hosts, args.forward, port=args.port, ssh_cmd=args.ssh_cmd,
         workdir=args.workdir, python=args.python, env_extra=env_extra,
-        dry_run=args.dry_run,
+        dry_run=args.dry_run, run_dir=args.run_dir,
     )
 
 
@@ -272,6 +274,12 @@ def main(argv=None) -> int:
     ll = sub.add_parser("launch-local", help="fork a local multi-process cluster (scripts/local.sh analog)")
     ll.add_argument("--num-processes", type=int, default=2)
     ll.add_argument("--port", type=int, default=0, help="coordinator port (0 = pick free)")
+    ll.add_argument("--run-dir", default="",
+                    help="collect per-rank telemetry here: each rank writes "
+                         "<run-dir>/metrics_rank<k>.jsonl (overrides any "
+                         "train.metrics_path in the forwarded args) and all "
+                         "ranks share one run_id; summarize with "
+                         "tools/metrics_report.py")
     ll.add_argument("forward", nargs=argparse.REMAINDER,
                     help="-- followed by `xflow train` args to run in every process")
     ll.set_defaults(fn=cmd_launch_local)
@@ -293,6 +301,12 @@ def main(argv=None) -> int:
     ld.add_argument("--python", default="", help="remote python (default python3)")
     ld.add_argument("--env", action="append", metavar="K=V",
                     help="extra env for every rank (repeatable)")
+    ld.add_argument("--run-dir", default="",
+                    help="REMOTE dir (shared filesystem recommended) for "
+                         "per-rank telemetry: each rank writes "
+                         "<run-dir>/metrics_rank<k>.jsonl and all ranks share "
+                         "one run_id (XFLOW_RUN_ID); summarize with "
+                         "tools/metrics_report.py")
     ld.add_argument("--dry-run", action="store_true",
                     help="print the per-host command lines instead of running")
     ld.add_argument("forward", nargs=argparse.REMAINDER,

@@ -68,9 +68,15 @@ def rank_command(
     workdir: str = "",
     python: str = "",
     env_extra: dict | None = None,
+    run_dir: str = "",
 ) -> str:
     """The exact shell line rank `rank` runs on `host` (also what
-    --dry-run prints)."""
+    --dry-run prints). `run_dir` (a REMOTE path, typically on a shared
+    filesystem) points this rank's metrics JSONL at
+    `<run_dir>/metrics_rank<rank>.jsonl` — collect the files afterwards
+    and summarize with tools/metrics_report.py."""
+    from xflow_tpu.launch.local import rank_metrics_args
+
     coordinator_host = hosts[0].rsplit("@", 1)[-1]  # strip user@ for the address
     env = {
         "XFLOW_COORDINATOR": f"{coordinator_host}:{port}",
@@ -78,6 +84,7 @@ def rank_command(
         "XFLOW_PROCESS_ID": str(rank),
         **(env_extra or {}),
     }
+    forward_args = [*forward_args, *rank_metrics_args(run_dir, rank)]
     py = python or "python3"
     parts = []
     if workdir:
@@ -124,6 +131,7 @@ def launch_dist(
     python: str = "",
     env_extra: dict | None = None,
     dry_run: bool = False,
+    run_dir: str = "",
 ) -> int:
     """Start one rank per host over ssh and wait for all of them.
 
@@ -141,8 +149,16 @@ def launch_dist(
 
     if forward_args and forward_args[0] == "--":
         forward_args = forward_args[1:]
+    # one run id across all ranks, ALWAYS (not just under --run-dir:
+    # ranks given a metrics_path via forwarded --set args must join
+    # too) — the per-rank JSONL streams group on it
+    from xflow_tpu.launch.local import resolve_launch_run_id
+
+    env_extra = dict(env_extra or {})
+    env_extra.setdefault("XFLOW_RUN_ID", resolve_launch_run_id())
     cmds = [
-        rank_command(h, i, hosts, forward_args, port, workdir, python, env_extra)
+        rank_command(h, i, hosts, forward_args, port, workdir, python, env_extra,
+                     run_dir=run_dir)
         for i, h in enumerate(hosts)
     ]
     if dry_run:

@@ -25,11 +25,41 @@ def _free_port() -> int:
     return port
 
 
-def launch_local(num_processes: int, forward_args: list[str], port: int = 0) -> int:
+def rank_metrics_args(run_dir: str, rank: int) -> list[str]:
+    """Extra `xflow train` args pointing rank `rank`'s metrics JSONL
+    into the run dir — ONE file per rank (two ranks appending to one
+    file would interleave mid-line under concurrent flush). Shared by
+    launch-local and launch-dist so the layout
+    (`<run_dir>/metrics_rank<k>.jsonl`, what tools/metrics_report.py
+    globs) is defined once."""
+    if not run_dir:
+        return []
+    path = os.path.join(run_dir, f"metrics_rank{rank}.jsonl")
+    return ["--set", f"train.metrics_path={path}"]
+
+
+def resolve_launch_run_id() -> str:
+    """The run id every rank of this launch stamps: honor an
+    operator-exported XFLOW_RUN_ID, else mint one PER LAUNCH (two
+    launches from one driver process must not share an id, so this is
+    telemetry.new_run_id, not the process-cached resolve_run_id)."""
+    from xflow_tpu.telemetry import new_run_id
+
+    return new_run_id()
+
+
+def launch_local(
+    num_processes: int, forward_args: list[str], port: int = 0, run_dir: str = ""
+) -> int:
     if forward_args and forward_args[0] == "--":
         forward_args = forward_args[1:]
     port = port or _free_port()
     coordinator = f"127.0.0.1:{port}"
+    # one run id across all ranks: their metrics/quarantine JSONL
+    # streams join on it (telemetry.resolve_run_id reads the env)
+    run_id = resolve_launch_run_id()
+    if run_dir:
+        os.makedirs(run_dir, exist_ok=True)
     procs = []
     for rank in range(num_processes):
         env = dict(os.environ)
@@ -37,6 +67,7 @@ def launch_local(num_processes: int, forward_args: list[str], port: int = 0) -> 
             XFLOW_COORDINATOR=coordinator,
             XFLOW_NUM_PROCESSES=str(num_processes),
             XFLOW_PROCESS_ID=str(rank),
+            XFLOW_RUN_ID=run_id,
             # Children MUST default to CPU: inheriting an ambient
             # accelerator platform would land every child on the same
             # device (this image pins one TPU), the world would never
@@ -46,7 +77,10 @@ def launch_local(num_processes: int, forward_args: list[str], port: int = 0) -> 
             # process-count assert catches any remaining mismatch.
             JAX_PLATFORMS=env.get("XFLOW_LAUNCH_PLATFORM", "cpu"),
         )
-        cmd = [sys.executable, "-m", "xflow_tpu", "train", *forward_args]
+        cmd = [
+            sys.executable, "-m", "xflow_tpu", "train",
+            *forward_args, *rank_metrics_args(run_dir, rank),
+        ]
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
     for p in procs:

@@ -492,14 +492,18 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
                    labels, row_mask):
         """Device (d, t) body: the shared forward (`_local_logits`) plus
         the loss reduction."""
-        logits = local_logits(
-            mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
-            labels.shape[0],
-        )
-        per_row = binary_logloss_from_logits(logits, labels)
-        loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
-        rows_n = jax.lax.psum(row_mask.sum(), DATA_AXIS)
-        return loss_sum / jnp.maximum(rows_n, 1.0), rows_n
+        # "gather" holds the forward: shard-local windowed gather, the
+        # occurrence all_to_all, and the row-aggregate return collectives
+        with jax.named_scope("gather"):
+            logits = local_logits(
+                mode, tbl_local, fs_slots, fs_row, fs_mask, fs_off, fs_fields,
+                labels.shape[0],
+            )
+        with jax.named_scope("loss"):
+            per_row = binary_logloss_from_logits(logits, labels)
+            loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
+            rows_n = jax.lax.psum(row_mask.sum(), DATA_AXIS)
+            return loss_sum / jnp.maximum(rows_n, 1.0), rows_n
 
     fs_spec = P(DATA_AXIS, TABLE_AXIS, None, None)
 
@@ -539,12 +543,16 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
             )
 
         def train_step(state: TrainState, batch: dict):
-            (loss, rows), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(
-                state.tables[tname], batch
-            )
-            new_tables, new_opt = optimizer.apply(
-                {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
-            )
+            # "grad" covers forward+backward: the scatter (gather's
+            # transpose, staying on the owning device) lands here
+            with jax.named_scope("grad"):
+                (loss, rows), grads = jax.value_and_grad(
+                    loss_for_grad, has_aux=True
+                )(state.tables[tname], batch)
+            with jax.named_scope("optimizer"):
+                new_tables, new_opt = optimizer.apply(
+                    {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
+                )
             metrics = {"loss": loss, "rows": rows}
             # non-finite guard: update_ok computed from replicated loss +
             # the sharded updated leaves (the isfinite reduction GSPMDs to

@@ -134,10 +134,11 @@ def make_sorted_sharded_train_step(
         # never touch (their chunk ranges come from off_local) and the
         # in-span mask removes from compute
         slots_local = sorted_slots - t_idx * S_local
-        occ_t = table_gather_sorted(
-            wv_local, slots_local, off_local, cfg.data.sorted_bf16,
-            pack_of(wv_local, K),
-        )  # [K8, Np_l]
+        with jax.named_scope("gather"):
+            occ_t = table_gather_sorted(
+                wv_local, slots_local, off_local, cfg.data.sorted_bf16,
+                pack_of(wv_local, K),
+            )  # [K8, Np_l]
         pos = jnp.arange(sorted_slots.shape[0], dtype=jnp.int32)
         in_span = (pos >= off_local[0]) & (pos < off_local[-1])
         # where() (not multiply) so untouched positions — which may hold
@@ -145,16 +146,17 @@ def make_sorted_sharded_train_step(
         occm_t = jnp.where(in_span[None, :], occ_t[:K], 0.0) * sorted_mask[None, :]
         from xflow_tpu.models.fm import stack_channels
 
-        stacked = stack_channels(occm_t, K)
-        partial_sums = row_sums_sorted(stacked, sorted_row, labels.shape[0])
-        sums = jax.lax.psum(partial_sums, TABLE_AXIS)  # the ONE fwd collective
-        from xflow_tpu.models.fm import fm_logits_from_sums
+        with jax.named_scope("loss"):
+            stacked = stack_channels(occm_t, K)
+            partial_sums = row_sums_sorted(stacked, sorted_row, labels.shape[0])
+            sums = jax.lax.psum(partial_sums, TABLE_AXIS)  # the ONE fwd collective
+            from xflow_tpu.models.fm import fm_logits_from_sums
 
-        logits = fm_logits_from_sums(sums, K, cfg)
-        per_row = binary_logloss_from_logits(logits, labels)
-        loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
-        rows = jax.lax.psum(row_mask.sum(), DATA_AXIS)
-        return loss_sum / jnp.maximum(rows, 1.0), rows
+            logits = fm_logits_from_sums(sums, K, cfg)
+            per_row = binary_logloss_from_logits(logits, labels)
+            loss_sum = jax.lax.psum((per_row * row_mask).sum(), DATA_AXIS)
+            rows = jax.lax.psum(row_mask.sum(), DATA_AXIS)
+            return loss_sum / jnp.maximum(rows, 1.0), rows
 
     @partial(
         shard_map,
@@ -187,15 +189,19 @@ def make_sorted_sharded_train_step(
         return loss, rows
 
     def train_step(state: TrainState, batch: dict):
-        (loss, rows), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(
-            state.tables["wv"], batch
-        )
-        new_tables, new_opt = optimizer.apply(
-            {"wv": state.tables["wv"]},
-            state.opt_state,
-            {"wv": grads},
-            cfg,
-        )
+        # "grad" covers forward+backward: the windowed scatter (the
+        # gather's transpose) and the 'data'-axis gradient psum land here
+        with jax.named_scope("grad"):
+            (loss, rows), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(
+                state.tables["wv"], batch
+            )
+        with jax.named_scope("optimizer"):
+            new_tables, new_opt = optimizer.apply(
+                {"wv": state.tables["wv"]},
+                state.opt_state,
+                {"wv": grads},
+                cfg,
+            )
         metrics = {"loss": loss, "rows": rows}
         # non-finite guard: same shared helper as every other engine
         # (train/step.py guard_nonfinite) — the discard select runs on

@@ -45,7 +45,11 @@ def make_sharded_train_step(
     bsh = batch_sharding(mesh)
 
     def sharded(state: TrainState, batch: dict):
-        return step(state, batch)
+        # inner gather/grad/optimizer scopes come from make_train_step;
+        # this outer scope brackets the whole GSPMD step (incl. the
+        # compiler-inserted collectives) in an xprof trace
+        with jax.named_scope("train_step"):
+            return step(state, batch)
 
     # the non-finite guard's update_ok flag rides in the metrics dict
     # (train/step.py metrics_keys), replicated like loss/rows

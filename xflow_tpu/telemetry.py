@@ -1,0 +1,466 @@
+"""Telemetry: the counters/gauges/timers registry, step-time
+decomposition, and programmatic profiler trace windows.
+
+The reference prints only loss/AUC lines to stdout
+(`/root/reference/src/model/lr/lr.cc` train loop), which is unusable for
+diagnosing a TPU trainer: async dispatch deliberately hides where the
+time goes (data-wait? host dispatch? device step?), and the stdout
+stream carries no rank identity, no timestamps, and nothing a tool can
+aggregate. This module is the first-class instrumentation layer:
+
+- `Registry` / `Counter` / `Gauge` / `Timer`: process-wide named
+  metrics. The data pipeline and the quarantine path report through the
+  default registry (data/pipeline.py, data/libffm.py); the trainer
+  snapshots it into every metrics-JSONL window record.
+- `StepTimer`: decomposes each train step into data-wait (iterator
+  next), host dispatch (plan resolve + transfer + async dispatch), and
+  device time — the device side measured ONE STEP BEHIND via a
+  block-until-ready on the *previous* step's metrics right after the
+  current step's dispatch, the same hide-under-device-time trick the
+  non-finite guard's flag read uses (train/trainer.py check_pending),
+  so the instrumentation adds no sync bubble to the pipeline.
+- `TraceWindow`: a programmatic xprof trace window
+  (`train.trace_start_step` / `train.trace_num_steps`) captured mid-run
+  after compilation settles, replacing the blunt whole-run
+  start/stop-trace (which buried the steady state under compile noise).
+
+Timing convention (docs/OBSERVABILITY.md): durations come from
+`time.perf_counter()` (monotonic — wall-clock `time.time()` jumps under
+NTP slew); the `ts` field every JSONL record carries (xflow_tpu/jsonl.py)
+is wall-clock, for cross-stream/cross-host log correlation only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+_RUN_ID: Optional[str] = None
+
+
+def new_run_id() -> str:
+    """A fresh launch-scoped id honoring an operator-exported
+    XFLOW_RUN_ID — the one place the env-var name and id format live
+    (launchers mint one per launch and export it to every rank)."""
+    return os.environ.get("XFLOW_RUN_ID") or uuid.uuid4().hex[:12]
+
+
+def resolve_run_id() -> str:
+    """One id per training run, identical on every rank: XFLOW_RUN_ID
+    when a launcher exported it (launch/local.py, launch/dist.py),
+    else one random id minted per process — cached so every sink in the
+    process (metrics stream, quarantine stream) stamps the SAME id and
+    the streams stay joinable."""
+    global _RUN_ID
+    rid = os.environ.get("XFLOW_RUN_ID")
+    if rid:
+        return rid
+    if _RUN_ID is None:
+        _RUN_ID = new_run_id()
+    return _RUN_ID
+
+
+def resolve_rank() -> int:
+    """This process's rank for record stamping. The launcher env
+    (XFLOW_PROCESS_ID) is authoritative and avoids touching jax from
+    sinks that open before distributed init; fall back to
+    jax.process_index() (0 single-process) once jax is importable."""
+    env = os.environ.get("XFLOW_PROCESS_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+# ------------------------------------------------------------------ registry
+
+
+class Counter:
+    """Monotonically increasing count. Thread-safe (the prefetch worker
+    increments data counters while the fit loop snapshots)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"Counter.inc({n}): counters are monotone, use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Timer:
+    """Duration accumulator with window percentiles.
+
+    `observe(seconds)` (or the `timing()` context manager) feeds both
+    the run totals (count / total_s — monotone, snapshot-friendly) and
+    the current window, which `percentile(q)` reads and
+    `window_reset()` clears — the StepTimer and the trainer's log
+    window share this reset cadence. The window is a bounded deque
+    (newest WINDOW_CAP observations) so a consumer that never resets —
+    a run with train.log_every=0 — cannot grow host memory for the
+    life of a pod-scale job."""
+
+    WINDOW_CAP = 8192
+
+    __slots__ = ("_lock", "count", "total_s", "_window")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total_s = 0.0
+        self._window: deque = deque(maxlen=self.WINDOW_CAP)
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += float(seconds)
+            self._window.append(float(seconds))
+
+    def timing(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                timer.observe(time.perf_counter() - self._t0)
+                return False
+
+        return _Ctx()
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile (0..100) over the CURRENT window; NaN when
+        the window is empty."""
+        with self._lock:
+            if not self._window:
+                return float("nan")
+            return float(np.percentile(np.asarray(self._window), q))
+
+    def window_reset(self) -> list:
+        """Return and clear the current window's observations."""
+        with self._lock:
+            out = list(self._window)
+            self._window.clear()
+            return out
+
+
+class Registry:
+    """Create-or-get named metrics. One flat namespace; a name is
+    permanently one kind (asking for a counter where a gauge lives is a
+    bug, reported loudly)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls()
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"telemetry metric {name!r} is a {type(m).__name__}, "
+                    f"not a {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self) -> dict:
+        """Flat {name: value} of every metric — counters/gauges by
+        value, timers as `<name>.count` / `<name>.total_s`. Values are
+        run totals (monotone for counters/timers), so consumers join
+        across windows by diffing."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: dict = {}
+        for name, m in items:
+            if isinstance(m, Timer):
+                out[f"{name}.count"] = m.count
+                out[f"{name}.total_s"] = round(m.total_s, 6)
+            else:  # Counter / Gauge
+                out[name] = m.value
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a fresh fit() keeps run totals)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_DEFAULT = Registry()
+
+
+def default_registry() -> Registry:
+    """The process-wide registry the pipeline/quarantine counters and
+    the trainer's window snapshots share."""
+    return _DEFAULT
+
+
+# ----------------------------------------------------------------- StepTimer
+
+
+def _block(tree) -> None:
+    """block_until_ready on a pytree of (possibly jax) values; host
+    numpy passes through untouched so StepTimer is testable without a
+    device."""
+    try:
+        import jax
+
+        jax.block_until_ready(tree)
+    except ImportError:
+        pass
+
+
+class StepTimer:
+    """One-step-behind step-time decomposition.
+
+    Per step i the fit loop calls:
+
+      for batch in st.batches(iterator):   # data-wait = time inside next()
+          ... resolve/shard/dispatch ...   # host dispatch
+          st.dispatched(metrics_i, rows)   # blocks on step i-1's metrics
+
+    `dispatched` records step i's host-side timings, then finishes step
+    i-1 by blocking on its (async) metrics — the block overlaps step i's
+    device execution, so no sync bubble is added; the cost model is the
+    non-finite guard's (train/trainer.py check_pending). Consequently a
+    step's record lands one call later, and the LAST step needs
+    `flush()` after the loop.
+
+    Per finished step:
+      - data_wait_s: time spent inside the iterator's next()
+      - dispatch_s:  fetch end -> dispatch return (plan resolve, host
+        transfer, async dispatch)
+      - device_s:    dispatch return -> metrics ready. When the device
+        is the bottleneck this is the device step time; when the host
+        is, the block returns immediately and it degrades to the
+        pipeline interval — an upper bound, never an undercount.
+      - step_s:      completion-to-completion interval. These telescope,
+        so their sum over a run equals the elapsed wall time (the
+        decomposition tests' invariant).
+    """
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._reg = registry or default_registry()
+        self._pending = None  # (metrics, rows, wait_s, dispatch_s, dispatch_end)
+        self._last_ready: Optional[float] = None
+        self._last_wait = 0.0
+        self._wait_end: Optional[float] = None
+        self._win_rows = 0
+        self._win: dict = {"step": [], "wait": [], "dispatch": [], "device": []}
+        self._win_start = time.perf_counter()
+        self.steps = 0
+        self.rows = 0
+
+    def batches(self, iterable: Iterable) -> Iterator:
+        """Wrap the batch iterator so time spent INSIDE next() — and
+        only that — is the step's data-wait. Abandonment (an early
+        break / exception in the consuming loop) closes the wrapped
+        iterator promptly, preserving the prefetch worker's
+        close-cascade contract (data/pipeline.py prefetch)."""
+        it = iter(iterable)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                self._wait_end = time.perf_counter()
+                self._last_wait = self._wait_end - t0
+                yield item
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+
+    def dispatched(self, metrics, rows: int) -> None:
+        """Call right after the step's async dispatch returns. Finishes
+        the PREVIOUS step (block-until-ready overlapping this step's
+        device execution) and stages this one."""
+        now = time.perf_counter()
+        wait_end = self._wait_end if self._wait_end is not None else now
+        cur = (metrics, int(rows), self._last_wait, now - wait_end, now)
+        self._finish_pending()
+        self._pending = cur
+
+    def flush(self) -> None:
+        """Finish the final in-flight step (its metrics have no
+        successor to hide behind — the one sync this class adds, at
+        end of data)."""
+        self._finish_pending()
+
+    def _finish_pending(self) -> None:
+        if self._pending is None:
+            return
+        metrics, rows, wait_s, dispatch_s, dispatch_end = self._pending
+        self._pending = None
+        _block(metrics)
+        t_ready = time.perf_counter()
+        device_s = t_ready - dispatch_end
+        # first step: anchor on its own fetch start so intervals telescope
+        base = (
+            self._last_ready
+            if self._last_ready is not None
+            else dispatch_end - dispatch_s - wait_s
+        )
+        self._last_ready = t_ready
+        self.steps += 1
+        self.rows += rows
+        self._win_rows += rows
+        w = self._win
+        w["step"].append(t_ready - base)
+        w["wait"].append(wait_s)
+        w["dispatch"].append(dispatch_s)
+        w["device"].append(device_s)
+        self._reg.timer("step.time").observe(t_ready - base)
+        self._reg.timer("step.data_wait").observe(wait_s)
+
+    def window_record(self) -> dict:
+        """Stats over the steps finished since the last call, then reset
+        the window. Empty dict when no step has finished yet (the very
+        first log tick under log_every=1 — timing runs one behind)."""
+        w = self._win
+        n = len(w["step"])
+        if n == 0:
+            return {}
+        now = time.perf_counter()
+        elapsed = max(now - self._win_start, 1e-9)
+        step_ms = np.asarray(w["step"]) * 1e3
+        rec = {
+            "steps_per_s": round(n / elapsed, 3),
+            "rows_per_s": round(self._win_rows / elapsed, 1),
+            "step_time_p50_ms": round(float(np.percentile(step_ms, 50)), 3),
+            "step_time_p99_ms": round(float(np.percentile(step_ms, 99)), 3),
+            "data_wait_ms": round(float(np.mean(w["wait"])) * 1e3, 3),
+            "dispatch_ms": round(float(np.mean(w["dispatch"])) * 1e3, 3),
+            "device_ms": round(float(np.mean(w["device"])) * 1e3, 3),
+        }
+        self._win = {"step": [], "wait": [], "dispatch": [], "device": []}
+        self._win_rows = 0
+        self._win_start = now
+        # shared cadence: the registry timers' percentile windows clear
+        # with the log window (their run totals are monotone and survive)
+        self._reg.timer("step.time").window_reset()
+        self._reg.timer("step.data_wait").window_reset()
+        return rec
+
+
+# --------------------------------------------------------------- TraceWindow
+
+
+class TraceWindow:
+    """Programmatic xprof trace window.
+
+    `train.trace_start_step >= 1` (with `train.profile_dir` set) starts
+    the trace just before that step's dispatch — after compilation has
+    settled, so the window shows the steady state instead of burying it
+    under compile noise — and stops it once `train.trace_num_steps`
+    steps have dispatched. `trace_start_step = 0` keeps the legacy
+    whole-run trace. `close()` (the fit loop's finally) stops a trace
+    still running when the data ends inside the window.
+
+    `profiler` is a test seam; the default is `jax.profiler`.
+    """
+
+    def __init__(
+        self,
+        profile_dir: str,
+        start_step: int = 0,
+        num_steps: int = 0,
+        profiler=None,
+    ):
+        self._dir = profile_dir
+        self._start = max(int(start_step), 0)
+        self._num = max(int(num_steps), 1)
+        self._running = False
+        self._done = not profile_dir
+        self._prof = profiler
+
+    def _profiler(self):
+        if self._prof is None:
+            import jax
+
+            self._prof = jax.profiler
+        return self._prof
+
+    def maybe_start_run(self) -> None:
+        """Pre-loop hook: whole-run mode (start_step=0) starts here."""
+        if not self._done and not self._running and self._start == 0:
+            self._profiler().start_trace(self._dir)
+            self._running = True
+
+    def before_step(self, step: int) -> None:
+        """Window mode: called with the 1-based step about to dispatch."""
+        if self._done or self._start == 0:
+            return
+        if not self._running and step == self._start:
+            self._profiler().start_trace(self._dir)
+            self._running = True
+        elif self._running and step >= self._start + self._num:
+            self._stop()
+
+    def _stop(self) -> None:
+        if self._running:
+            self._profiler().stop_trace()
+            self._running = False
+        self._done = True
+
+    def close(self) -> None:
+        """Stop a still-running trace (end of data / abnormal exit)."""
+        if self._running:
+            self._profiler().stop_trace()
+            self._running = False
+        self._done = True

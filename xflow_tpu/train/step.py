@@ -54,8 +54,13 @@ def masked_mean_logloss(logits, labels, row_mask):
 
 
 def loss_fn(tables, batch, model: Model, cfg: Config):
-    logits = model.forward(tables, batch, cfg)
-    return masked_mean_logloss(logits, batch["labels"], batch["row_mask"])
+    # named scopes label the xprof trace (docs/OBSERVABILITY.md): the
+    # forward holds the table gather; autodiff transposes it into the
+    # scatter, which lands under the enclosing "grad" scope
+    with jax.named_scope("gather"):
+        logits = model.forward(tables, batch, cfg)
+    with jax.named_scope("loss"):
+        return masked_mean_logloss(logits, batch["labels"], batch["row_mask"])
 
 
 def nonfinite_guard_on(cfg: Config) -> bool:
@@ -164,9 +169,10 @@ def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
         K = cfg.model.v_dim if mvm else 1 + cfg.model.v_dim
     table = state.tables[tname]
     pack = pack_of(table, K)
-    occ_t = table_gather_sorted(
-        table, batch["sorted_slots"], batch["win_off"], cfg.data.sorted_bf16, pack
-    )
+    with jax.named_scope("gather"):
+        occ_t = table_gather_sorted(
+            table, batch["sorted_slots"], batch["win_off"], cfg.data.sorted_bf16, pack
+        )
 
     def row_loss(occ):
         # the row side and the loss reduction are the SAME functions the
@@ -195,13 +201,17 @@ def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
             )
         return masked_mean_logloss(logits, batch["labels"], batch["row_mask"])
 
-    loss, vjp = jax.vjp(row_loss, occ_t)
-    (d_occ,) = vjp(jnp.ones_like(loss))
+    with jax.named_scope("loss"):
+        loss, vjp = jax.vjp(row_loss, occ_t)
+    with jax.named_scope("grad"):
+        (d_occ,) = vjp(jnp.ones_like(loss))
     st = state.opt_state[tname]
-    w_new, n_new, z_new = scatter_ftrl_sorted(
-        d_occ, batch["sorted_slots"], batch["win_off"], table, st["n"], st["z"],
-        K, cfg.optim.ftrl, cfg.data.sorted_bf16, pack,
-    )
+    # the fused kernel IS scatter + optimizer in one window write
+    with jax.named_scope("scatter_optimizer"):
+        w_new, n_new, z_new = scatter_ftrl_sorted(
+            d_occ, batch["sorted_slots"], batch["win_off"], table, st["n"], st["z"],
+            K, cfg.optim.ftrl, cfg.data.sorted_bf16, pack,
+        )
     metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
     return (
         TrainState({tname: w_new}, {tname: {"n": n_new, "z": z_new}}, state.step + 1),
@@ -250,8 +260,14 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
                 "cannot run; use auto to allow the two-pass form on "
                 "such batches"
             )
-        loss, grads = jax.value_and_grad(loss_fn)(state.tables, batch, model, cfg)
-        new_tables, new_opt = optimizer.apply(state.tables, state.opt_state, grads, cfg)
+        # "grad" wraps forward+backward: the backward's table scatter
+        # (the gather's transpose) shows up here in an xprof trace
+        with jax.named_scope("grad"):
+            loss, grads = jax.value_and_grad(loss_fn)(state.tables, batch, model, cfg)
+        with jax.named_scope("optimizer"):
+            new_tables, new_opt = optimizer.apply(
+                state.tables, state.opt_state, grads, cfg
+            )
         metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
         return guard_nonfinite(
             cfg, state, TrainState(new_tables, new_opt, state.step + 1), metrics

@@ -27,6 +27,12 @@ from xflow_tpu.jsonl import JsonlAppender
 from xflow_tpu.data.pipeline import batch_iterator, count_batches, prefetch
 from xflow_tpu.metrics import auc_logloss
 from xflow_tpu.models import get_model
+from xflow_tpu.telemetry import (
+    StepTimer,
+    TraceWindow,
+    default_registry,
+    resolve_run_id,
+)
 from xflow_tpu.optim import get_optimizer
 from xflow_tpu.train.state import TrainState, init_state
 from xflow_tpu.train.step import (
@@ -294,7 +300,13 @@ class Trainer:
             else 0
         )
         self._dedup_on = None  # undecided until the first row-major batch
-        self.metrics = MetricsLogger(cfg.train.metrics_path)
+        # provenance stamp: every metrics record carries ts/rank/run_id
+        # (jsonl.JsonlAppender) so per-rank streams from one run join
+        self.run_id = resolve_run_id()
+        self.metrics = MetricsLogger(
+            cfg.train.metrics_path,
+            stamp={"rank": self.rank, "run_id": self.run_id},
+        )
         # validate the guard mode at CONSTRUCTION (identical config on
         # every rank → rank-symmetric), not on the first bad batch
         self._guarded = nonfinite_guard_on(cfg)
@@ -726,9 +738,18 @@ class Trainer:
         cfg = self.cfg
         path = train_path or shard_path(cfg.data.train_path, self.rank)
         res = TrainResult()
-        start = time.time()
-        if cfg.train.profile_dir:
-            jax.profiler.start_trace(cfg.train.profile_dir)
+        # perf_counter for every DURATION (monotonic — wall clock jumps
+        # under NTP slew); the records' `ts` field (JsonlAppender) is the
+        # wall-clock correlation handle
+        start = time.perf_counter()
+        trace = TraceWindow(
+            cfg.train.profile_dir,
+            cfg.train.trace_start_step,
+            cfg.train.trace_num_steps,
+        )
+        trace.maybe_start_run()
+        steptimer = StepTimer()
+        registry = default_registry()
         last_metrics = None
         sig_flag, sig_restore = self._install_signal_checkpoint()
         multiproc = jax.process_count() > 1
@@ -801,12 +822,17 @@ class Trainer:
                 # quarantine on the FIRST pass only: later epochs see the
                 # same bad rows again (still counted/enforced), and one
                 # record per bad row beats epochs× duplicates
-                for batch, arrays in self._coordinated_batches(
-                    path, quarantine=epoch == 0
+                for batch, arrays in steptimer.batches(
+                    self._coordinated_batches(path, quarantine=epoch == 0)
                 ):
+                    trace.before_step(res.steps + 1)
                     arrays = self._resolve_fullshard_overflow(batch, arrays)
                     arrays = self._shard_batch(arrays)
                     self.state, m = self.train_step(self.state, arrays)
+                    # finish the PREVIOUS step's timing: the block on its
+                    # metrics overlaps this step's device execution, so
+                    # neither the timer nor the guard below adds a bubble
+                    steptimer.dispatched(m, batch.num_rows)
                     last_metrics = m
                     res.steps += 1
                     res.examples += batch.num_rows
@@ -827,15 +853,22 @@ class Trainer:
                         finite = loss == loss and abs(loss) != float("inf")
                         if finite or not self._guarded:
                             res.last_loss = loss
-                        self.metrics.log(
-                            {
-                                "step": res.steps,
-                                "epoch": epoch,
-                                "loss": loss if finite else None,
-                                "examples": res.examples,
-                                "elapsed_s": round(time.time() - start, 3),
-                            }
-                        )
+                        rec = {
+                            "step": res.steps,
+                            "epoch": epoch,
+                            "loss": loss if finite else None,
+                            "examples": res.examples,
+                            "elapsed_s": round(time.perf_counter() - start, 3),
+                        }
+                        # window stats: rows/s, steps/s, p50/p99 step
+                        # time, data-wait/dispatch/device decomposition
+                        # (telemetry.StepTimer; empty only at step 1
+                        # under log_every=1 — timing runs one behind)
+                        rec.update(steptimer.window_record())
+                        counters = registry.snapshot()
+                        if counters:
+                            rec["counters"] = counters
+                        self.metrics.log(rec)
                     if (
                         cfg.train.checkpoint_dir
                         and cfg.train.checkpoint_every
@@ -904,9 +937,11 @@ class Trainer:
                     res.last_loss = loss
         finally:
             sig_restore()
-            if cfg.train.profile_dir:
-                jax.profiler.stop_trace()
-        res.seconds = time.time() - start
+            trace.close()
+        # the final step's timing is still in flight (one behind); this
+        # block is the single end-of-data sync the timer adds
+        steptimer.flush()
+        res.seconds = time.perf_counter() - start
         # table occupancy: fraction of slots ever touched by a gradient —
         # the sparse-model health metric (SURVEY.md §5 "table-occupancy").
         # FTRL's n accumulator (n>0 ⇔ slot was pushed) is the reliable
@@ -936,7 +971,19 @@ class Trainer:
                 init = cfg.optim.v_init_sgd if t.ndim > 1 else 0.0
                 touched = slot_any(t != init, name) if t.ndim > 1 else t != init
             res.occupancy[name] = float(jnp.mean(touched))
-        self.metrics.log({"final": True, "steps": res.steps, "occupancy": res.occupancy})
+        final_rec = {
+            "final": True,
+            "steps": res.steps,
+            "examples": res.examples,
+            "elapsed_s": round(res.seconds, 3),
+            "occupancy": res.occupancy,
+        }
+        # tail window (steps since the last log tick) + run-total counters
+        final_rec.update(steptimer.window_record())
+        counters = registry.snapshot()
+        if counters:
+            final_rec["counters"] = counters
+        self.metrics.log(final_rec)
         if cfg.train.checkpoint_dir:
             self.save_checkpoint()
         return res
