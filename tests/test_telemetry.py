@@ -423,6 +423,65 @@ def test_metrics_report_tolerates_truncation(run_jsonl, tmp_path):
     assert r.returncode == 0, r.stderr  # damage is skipped, schema still OK
 
 
+def test_quarantine_stream_tolerates_truncation(run_jsonl, tmp_path):
+    """Regression (A3 satellite): a run dir holding BOTH a truncated
+    metrics stream and a truncated quarantine stream must still
+    summarize and --check clean — the SIGTERM/crash tail of either
+    stream is a skipped line, never a dead report."""
+    from xflow_tpu.data.pipeline import batch_iterator
+    from xflow_tpu.testing.faults import truncate_file, write_malformed_libffm
+
+    run = run_jsonl.parent
+    shard = tmp_path / "junk-00000"
+    write_malformed_libffm(str(shard), n_good=20, n_bad=3, seed=2)
+    qpath = run / "quarantine.jsonl"
+    cfg = override(
+        Config(),
+        **{
+            "data.batch_size": 16,
+            "data.max_bad_rows": 100,
+            "data.quarantine_path": str(qpath),
+            "data.log2_slots": 12,
+            "data.max_nnz": 8,
+        },
+    ).data
+    list(batch_iterator(str(shard), cfg))
+    # tear the tails of BOTH streams (the crash-mid-append shape)
+    truncate_file(str(qpath), keep_bytes=os.path.getsize(qpath) - 20)
+    truncate_file(str(run_jsonl), keep_bytes=os.path.getsize(run_jsonl) - 25)
+    recs, skipped = read_jsonl_counted(str(qpath))
+    assert recs and skipped == 1
+    assert all("ts" in r and "rank" in r and "run_id" in r for r in recs)
+    r = _report([str(run), "--check"])
+    assert r.returncode == 0, r.stderr
+    assert "2 damaged line(s) skipped" in r.stdout
+    r = _report([str(run)])
+    assert r.returncode == 0, r.stderr
+
+
+def test_metrics_report_check_accepts_heartbeat_stream(run_jsonl):
+    """A heartbeat stream in the run dir is its own (kind-keyed) stream:
+    its step sequence must not be merged into the metrics stream's
+    monotonicity check, and its shape is validated."""
+    hb = run_jsonl.parent / "heartbeat_rank0.jsonl"
+    a = JsonlAppender(
+        str(hb), stamp={"rank": 0, "run_id": "hbrun", "kind": "heartbeat"}
+    )
+    a.append({"event": "start", "step": 0})
+    for s in (10, 20, 30):
+        a.append({"step": s})
+    a.append({"event": "final", "step": 30})
+    a.close()
+    r = _report([str(run_jsonl.parent), "--check"])
+    assert r.returncode == 0, r.stderr
+    # a heartbeat record that is neither a beat nor an event fails
+    a.append({"nonsense": True})
+    a.close()
+    r = _report([str(run_jsonl.parent), "--check"])
+    assert r.returncode != 0
+    assert "neither a step heartbeat nor an event" in r.stderr
+
+
 def test_metrics_report_bench_json(run_jsonl, tmp_path):
     out = tmp_path / "bench.json"
     r = _report([str(run_jsonl), "--bench-json", str(out)])

@@ -2,25 +2,40 @@
 """Summarize xflow telemetry JSONL runs (docs/OBSERVABILITY.md).
 
 Loads one or more metrics JSONL files (or run directories — every
-`*.jsonl` inside), groups records by (run_id, rank), and prints a
-throughput / loss / bad-step summary table. Reading is
-truncation-tolerant (xflow_tpu.jsonl.read_jsonl_counted): a crash
-mid-append leaves a partial last line, which is skipped with a warning,
-never an exception.
+`*.jsonl` inside), groups records by (run_id, rank, kind) — `kind`
+separates the metrics, heartbeat, and watchdog streams a run dir
+holds — and prints a throughput / loss / bad-step summary table.
+Reading is truncation-tolerant (xflow_tpu.jsonl.read_jsonl_counted):
+a crash mid-append leaves a partial last line, which is skipped with
+a warning, never an exception.
 
     python tools/metrics_report.py runs/exp1/               # summary table
     python tools/metrics_report.py a.jsonl b.jsonl          # multiple files
     python tools/metrics_report.py runs/exp1 --check        # schema gate (CI)
+    python tools/metrics_report.py runs/exp1 --health       # health summary
     python tools/metrics_report.py runs/exp1 --bench-json - # BENCH-style JSON
+    python tools/metrics_report.py runs/exp1 --regress BENCH_r05.json
 
 `--check` validates the telemetry schema — every record stamped with
 ts/rank/run_id, step numbers monotone per stream, window records
-carrying the full decomposition key set — and exits nonzero on any
+carrying the full decomposition key set, health fields all-or-none,
+eval and heartbeat records complete — and exits nonzero on any
 violation (tools/smoke_telemetry.sh gates on it).
+
+`--health` renders the model-health view: norm trends, loss EMA, the
+AUC trajectory, occupancy/collision gauges, and a per-rank heartbeat
+table (straggler/dead classification via launch/watchdog.py, with
+"now" = the newest heartbeat seen, so a finished run reads as
+finished, not dead).
 
 `--bench-json` emits a BENCH-style perf-trajectory record (the shape
 bench.py prints) computed from the run's own telemetry, so a training
 run doubles as a benchmark sample without a separate bench invocation.
+
+`--regress BASELINE.json` compares this run's bench record (and AUC,
+when both sides have one) against a previously saved baseline and
+exits 3 on regression beyond `--regress-tol` / `--auc-tol` — the CI
+gate that keeps the bench trajectory honest.
 """
 
 from __future__ import annotations
@@ -47,6 +62,9 @@ WINDOW_KEYS = (
     "dispatch_ms",
     "device_ms",
 )
+# the health keys a health-enabled window record carries (telemetry
+# .HealthMonitor.window_record); --check enforces all-or-none too
+HEALTH_KEYS = ("grad_norm", "update_norm", "param_norm", "loss_ema")
 STAMP_KEYS = ("ts", "rank", "run_id")
 
 
@@ -68,17 +86,31 @@ def expand_paths(paths: list[str]) -> list[str]:
 
 
 def load_streams(files: list[str]) -> tuple[dict, int]:
-    """{(run_id, rank): [records in file order]} across all files, plus
-    the total damaged-line count."""
+    """{(run_id, rank, kind): [records in file order]} across all files,
+    plus the total damaged-line count. `kind` defaults to "metrics" for
+    unstamped legacy streams; heartbeat/watchdog records stamp theirs."""
     streams: dict = {}
     skipped_total = 0
     for path in files:
         records, skipped = read_jsonl_counted(path)
         skipped_total += skipped
         for rec in records:
-            key = (str(rec.get("run_id", "?")), rec.get("rank", "?"))
+            key = (
+                str(rec.get("run_id", "?")),
+                rec.get("rank", "?"),
+                str(rec.get("kind", "metrics")),
+            )
             streams.setdefault(key, []).append(rec)
     return streams, skipped_total
+
+
+def metrics_streams(streams: dict) -> dict:
+    """The (run_id, rank) -> records subset holding trainer metrics."""
+    return {
+        (rid, rank): recs
+        for (rid, rank, kind), recs in streams.items()
+        if kind == "metrics"
+    }
 
 
 def _finite(x) -> bool:
@@ -86,7 +118,7 @@ def _finite(x) -> bool:
 
 
 def summarize_stream(records: list[dict]) -> dict:
-    """One summary row for a (run_id, rank) stream."""
+    """One summary row for a (run_id, rank) metrics stream."""
     steps_recs = [r for r in records if "step" in r and "loss" in r]
     windows = [r for r in records if "rows_per_s" in r]
     counters = [r["counters"] for r in records if isinstance(r.get("counters"), dict)]
@@ -114,7 +146,17 @@ def summarize_stream(records: list[dict]) -> dict:
     )
     bad_rows = max((c.get("data.bad_rows", 0) for c in counters), default=0)
 
+    def series(key):
+        return [r[key] for r in records if _finite(r.get(key))]
+
+    grads = series("grad_norm")
+    grad_maxes = series("grad_norm_max")
+    emas = series("loss_ema")
+    occs = series("table_occupancy")
+    colls = series("est_collision_rate")
+
     med = lambda xs: sorted(xs)[len(xs) // 2] if xs else float("nan")
+    last = lambda xs: xs[-1] if xs else float("nan")
     return {
         "steps": int(steps),
         "examples": int(examples),
@@ -127,8 +169,18 @@ def summarize_stream(records: list[dict]) -> dict:
         "last_loss": losses[-1] if losses else float("nan"),
         "bad_steps": int(bad_steps),
         "bad_rows": int(bad_rows),
-        "eval_auc": evals[-1] if evals else float("nan"),
+        "eval_auc": last(evals),
         "windows": len(windows),
+        # health trajectory (docs/OBSERVABILITY.md "Health metrics")
+        "grad_norm_first": grads[0] if grads else float("nan"),
+        "grad_norm_last": last(grads),
+        "grad_norm_max": max(grad_maxes) if grad_maxes else float("nan"),
+        "update_norm_last": last(series("update_norm")),
+        "param_norm_last": last(series("param_norm")),
+        "loss_ema_last": last(emas),
+        "occupancy_last": last(occs),
+        "est_collision_rate_last": last(colls),
+        "auc_trajectory": evals,
     }
 
 
@@ -138,8 +190,8 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
     problems: list[str] = []
     if not streams:
         problems.append(f"no records in {', '.join(files)}")
-    for (run_id, rank), records in sorted(streams.items(), key=str):
-        tag = f"run {run_id} rank {rank}"
+    for (run_id, rank, kind), records in sorted(streams.items(), key=str):
+        tag = f"run {run_id} rank {rank} [{kind}]"
         last_step = -1
         step_recs = 0
         window_recs = 0
@@ -167,7 +219,28 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                         f"{tag}: record {i} has window keys {present} but "
                         f"lacks {missing}"
                     )
-        if step_recs >= 2 and window_recs == 0:
+            # health fields are all-or-none per record (null allowed for
+            # a not-yet-available value, absence is the violation)
+            h_present = [k for k in HEALTH_KEYS if k in rec]
+            if h_present:
+                h_missing = [k for k in HEALTH_KEYS if k not in rec]
+                if h_missing:
+                    problems.append(
+                        f"{tag}: record {i} has health keys {h_present} "
+                        f"but lacks {h_missing}"
+                    )
+            # an eval record carries BOTH quality numbers
+            if ("eval_auc" in rec) != ("eval_logloss" in rec):
+                problems.append(
+                    f"{tag}: record {i} has one of eval_auc/eval_logloss "
+                    "without the other"
+                )
+            if kind == "heartbeat" and "step" not in rec and "event" not in rec:
+                problems.append(
+                    f"{tag}: record {i} is neither a step heartbeat nor "
+                    "an event"
+                )
+        if kind == "metrics" and step_recs >= 2 and window_recs == 0:
             problems.append(
                 f"{tag}: {step_recs} step records but no window record — "
                 "StepTimer stats never landed"
@@ -199,33 +272,40 @@ def render_table(rows: list[tuple]) -> str:
     return "\n".join(lines)
 
 
+def _newest_run(streams: dict) -> str:
+    """run_id whose records carry the largest ts."""
+    def run_ts(run_id: str) -> float:
+        return max(
+            (r.get("ts", 0.0) for (rid, _, _), recs in streams.items()
+             if rid == run_id for r in recs if _finite(r.get("ts"))),
+            default=0.0,
+        )
+
+    run_ids = {rid for rid, _, _ in streams}
+    return max(run_ids, key=run_ts) if run_ids else "?"
+
+
 def bench_record(streams: dict) -> dict:
     """BENCH-style perf record over the newest run: summed per-rank
     examples over the longest rank elapsed — the honest cross-rank
     aggregate (ranks run the same global steps; examples counters are
-    per-rank local rows)."""
+    per-rank local rows). Carries the last streaming-eval AUC when the
+    run logged one, so --regress can gate quality too."""
     if not streams:
         return {}
-    # newest run = the one whose records carry the largest ts
-    def run_ts(run_id: str) -> float:
-        return max(
-            (r.get("ts", 0.0) for (rid, _), recs in streams.items() if rid == run_id
-             for r in recs if _finite(r.get("ts"))),
-            default=0.0,
-        )
-
-    run_ids = {rid for rid, _ in streams}
-    newest = max(run_ids, key=run_ts)
+    newest = _newest_run(streams)
     rows = {
         rank: summarize_stream(recs)
-        for (rid, rank), recs in streams.items()
+        for (rid, rank), recs in metrics_streams(streams).items()
         if rid == newest
     }
+    if not rows:
+        return {}
     examples = sum(s["examples"] for s in rows.values())
     elapsed = max((s["elapsed_s"] for s in rows.values()), default=0.0)
     steps = max((s["steps"] for s in rows.values()), default=0)
     value = examples / elapsed if elapsed > 0 else 0.0
-    return {
+    rec = {
         "metric": "telemetry_examples_per_sec",
         "value": round(value, 1),
         "unit": "examples/sec",
@@ -236,6 +316,108 @@ def bench_record(streams: dict) -> dict:
         "elapsed_s": round(elapsed, 3),
         "bad_steps": int(sum(s["bad_steps"] for s in rows.values())),
     }
+    aucs = [s["eval_auc"] for s in rows.values() if _finite(s["eval_auc"])]
+    if aucs:
+        rec["auc"] = round(max(aucs), 6)
+    return rec
+
+
+# ----------------------------------------------------------------- --health
+
+
+def heartbeat_rows(streams: dict, run_id: str) -> list[dict]:
+    """Straggler/dead classification over the run's heartbeat streams,
+    via the same fold + classifier the live launcher watchdog uses —
+    with "now" anchored to the newest heartbeat anywhere in the run
+    (offline post-mortem: wall-clock now would read every finished run
+    as dead)."""
+    from xflow_tpu.launch.watchdog import classify, fold_heartbeats
+
+    beats: dict = {}
+    for (rid, _rank, kind), recs in streams.items():
+        if rid == run_id and kind == "heartbeat":
+            fold_heartbeats(recs, beats)
+    if not beats:
+        return []
+    now = max(b["ts"] for b in beats.values())
+    return classify(beats, now)
+
+
+def render_health(streams: dict) -> str:
+    """The --health view for the newest run."""
+    newest = _newest_run(streams)
+    lines = [f"health report — run {newest}"]
+    fmt = lambda v: f"{v:.4g}" if _finite(v) else "-"
+    for (rid, rank), recs in sorted(metrics_streams(streams).items(), key=str):
+        if rid != newest:
+            continue
+        s = summarize_stream(recs)
+        lines.append(
+            f"  rank {rank}: steps {s['steps']}  loss {fmt(s['last_loss'])}  "
+            f"loss_ema {fmt(s['loss_ema_last'])}"
+        )
+        lines.append(
+            f"    norms: grad {fmt(s['grad_norm_first'])} -> "
+            f"{fmt(s['grad_norm_last'])} (max {fmt(s['grad_norm_max'])})  "
+            f"update {fmt(s['update_norm_last'])}  "
+            f"param {fmt(s['param_norm_last'])}"
+        )
+        lines.append(
+            f"    table: occupancy {fmt(s['occupancy_last'])}  "
+            f"est_collision_rate {fmt(s['est_collision_rate_last'])}"
+        )
+        traj = s["auc_trajectory"]
+        if traj:
+            lines.append(
+                f"    auc trajectory ({len(traj)} evals): "
+                f"{fmt(traj[0])} -> {fmt(traj[-1])}"
+                + ("  [declining]" if traj[-1] < traj[0] else "")
+            )
+        else:
+            lines.append("    auc trajectory: none (train.eval_every off?)")
+    hb = heartbeat_rows(streams, newest)
+    if hb:
+        lines.append("  heartbeats (lowest step first = the culprit ordering):")
+        for row in hb:
+            flag = "" if row["status"] in ("ok", "finished") else "  <-- " + row["status"].upper()
+            lines.append(
+                f"    rank {row['rank']}: step {row['step']}/{row['max_step']}"
+                f"  last beat {row['age_s']:.1f}s before run end"
+                f"  [{row['status']}]{flag}"
+            )
+    else:
+        lines.append("  heartbeats: none (train.heartbeat_path off?)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- --regress
+
+
+def check_regression(
+    current: dict, baseline: dict, tol: float, auc_tol: float
+) -> list[str]:
+    """Failures ([] = pass) comparing this run's bench record against a
+    saved BENCH-style baseline. Throughput gates when both sides carry a
+    value; AUC gates when both sides carry one."""
+    problems = []
+    base_v = baseline.get("value")
+    cur_v = current.get("value")
+    if _finite(base_v) and base_v > 0:
+        if not _finite(cur_v):
+            problems.append("current run has no throughput value")
+        elif cur_v < (1.0 - tol) * base_v:
+            problems.append(
+                f"throughput regressed: {cur_v:.1f} < (1-{tol})*baseline "
+                f"{base_v:.1f} {baseline.get('unit', '')}"
+            )
+    base_auc = baseline.get("auc")
+    cur_auc = current.get("auc")
+    if _finite(base_auc) and _finite(cur_auc) and cur_auc < base_auc - auc_tol:
+        problems.append(
+            f"AUC regressed: {cur_auc:.6f} < baseline {base_auc:.6f} - "
+            f"{auc_tol}"
+        )
+    return problems
 
 
 def main(argv=None) -> int:
@@ -245,8 +427,18 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="+", help="JSONL file(s) and/or run dir(s)")
     ap.add_argument("--check", action="store_true",
                     help="schema-validate and exit nonzero on violation")
+    ap.add_argument("--health", action="store_true",
+                    help="model-health summary: norm trends, AUC trajectory, "
+                         "occupancy, heartbeat/straggler table")
     ap.add_argument("--bench-json", default="",
                     help="write a BENCH-style perf JSON here ('-' = stdout)")
+    ap.add_argument("--regress", default="", metavar="BASELINE.json",
+                    help="gate against a saved BENCH-style baseline; exit 3 "
+                         "on throughput/AUC regression")
+    ap.add_argument("--regress-tol", type=float, default=0.2,
+                    help="allowed fractional throughput drop (default 0.2)")
+    ap.add_argument("--auc-tol", type=float, default=0.01,
+                    help="allowed absolute AUC drop (default 0.01)")
     args = ap.parse_args(argv)
 
     try:
@@ -269,20 +461,32 @@ def main(argv=None) -> int:
         )
         return 0
 
-    rows = []
-    for (run_id, rank), records in sorted(streams.items(), key=str):
-        s = summarize_stream(records)
-        rows.append((
-            run_id, rank, s["steps"], s["examples"], round(s["elapsed_s"], 1),
-            s["examples_per_s"], s["rows_per_s"], s["p50_ms"], s["p99_ms"],
-            s["data_wait_ms"], s["last_loss"], s["bad_steps"], s["bad_rows"],
-            s["eval_auc"],
-        ))
-    if rows:
-        print(render_table(rows))
-    else:
+    if not streams:
+        # both views: an empty/wrong directory must not read as passing
         print("metrics_report: no records found", file=sys.stderr)
         return 1
+
+    if args.health:
+        # the health view replaces the summary table; --bench-json and
+        # --regress below still run (a CI line can combine them)
+        print(render_health(streams))
+    else:
+        rows = []
+        for (run_id, rank), records in sorted(
+            metrics_streams(streams).items(), key=str
+        ):
+            s = summarize_stream(records)
+            rows.append((
+                run_id, rank, s["steps"], s["examples"], round(s["elapsed_s"], 1),
+                s["examples_per_s"], s["rows_per_s"], s["p50_ms"], s["p99_ms"],
+                s["data_wait_ms"], s["last_loss"], s["bad_steps"], s["bad_rows"],
+                s["eval_auc"],
+            ))
+        if rows:
+            print(render_table(rows))
+        else:
+            print("metrics_report: no records found", file=sys.stderr)
+            return 1
     if skipped:
         print(f"# {skipped} damaged line(s) skipped (truncated append?)")
 
@@ -294,6 +498,22 @@ def main(argv=None) -> int:
         else:
             with open(args.bench_json, "w") as f:
                 f.write(out + "\n")
+
+    if args.regress:
+        try:
+            with open(args.regress) as f:
+                baseline = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"metrics_report: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        problems = check_regression(
+            bench_record(streams), baseline, args.regress_tol, args.auc_tol
+        )
+        if problems:
+            for p in problems:
+                print(f"metrics_report: REGRESSION: {p}", file=sys.stderr)
+            return 3
+        print(f"metrics_report: no regression vs {args.regress}")
     return 0
 
 

@@ -1,7 +1,12 @@
 #!/usr/bin/env bash
 # Telemetry smoke gate (docs/OBSERVABILITY.md): a 50-step synthetic CPU
-# train with the metrics JSONL on, then a schema validation of what it
-# emitted via tools/metrics_report.py --check, then the human summary.
+# train with the metrics JSONL, health metrics, heartbeats, and a
+# streaming holdout eval on, then a schema validation of what it
+# emitted via tools/metrics_report.py --check (extended schema: health
+# fields all-or-none, eval records complete, heartbeat stream shape),
+# the --health summary, the human summary table, a BENCH-style perf
+# datapoint (BENCH_r06.json — the per-PR bench-trajectory convention,
+# docs/PERF.md), and a --regress self-check against that fresh baseline.
 #
 # Standalone:    bash tools/smoke_telemetry.sh [workdir]
 # From pytest:   tests/test_telemetry.py::test_smoke_telemetry_script
@@ -9,29 +14,51 @@
 # With no workdir argument a temp dir is created and cleaned up.
 set -eu
 cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
 
 WORK="${1:-}"
+# where the bench datapoint lands: the repo root ONLY on a standalone
+# (argument-less) invocation — the per-PR record. With a workdir given
+# (pytest runs), it stays in the workdir so test runs never rewrite
+# the committed BENCH_r06.json with machine-local numbers.
+BENCH_OUT="$ROOT/BENCH_r06.json"
 if [ -z "$WORK" ]; then
     WORK="$(mktemp -d)"
     trap 'rm -rf "$WORK"' EXIT
+else
+    BENCH_OUT="$WORK/BENCH_r06.json"
 fi
 
 export JAX_PLATFORMS=cpu
 
-# 3200 rows / batch 64 = 50 steps
+# 3200 rows / batch 64 = 50 steps; the test split shares the planted
+# truth (truth-seed) so the streaming AUC is meaningful
 python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
     --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+python -m xflow_tpu gen-data "$WORK/test" --shards 1 --rows 640 \
+    --fields 6 --ids-per-field 50 --seed 1 --truth-seed 0 >/dev/null
 
 python -m xflow_tpu train \
-    --train "$WORK/train" --model lr --epochs 1 \
+    --train "$WORK/train" --test "$WORK/test" --model lr --epochs 1 \
     --batch-size 64 --log2-slots 12 --no-mesh \
     --set model.num_fields=6 \
     --set data.max_nnz=8 \
     --set train.pred_dump=false \
     --set train.log_every=10 \
+    --set train.eval_every=1 \
+    --set train.health_metrics=norms \
+    --set train.heartbeat_every=10 \
     --set "train.metrics_path=$WORK/run/metrics_rank0.jsonl" \
+    --set "train.heartbeat_path=$WORK/run/heartbeat_rank0.jsonl" \
     >/dev/null
 
 python tools/metrics_report.py "$WORK/run" --check
+python tools/metrics_report.py "$WORK/run" --health
 python tools/metrics_report.py "$WORK/run"
+# per-PR bench datapoint (docs/PERF.md "Bench trajectory"): the smoke
+# run's own telemetry, in the BENCH_rNN.json series (repo root when
+# standalone, workdir when driven by pytest — see BENCH_OUT above)
+python tools/metrics_report.py "$WORK/run" --bench-json "$BENCH_OUT"
+# regression gate self-check: a run can never regress against itself
+python tools/metrics_report.py "$WORK/run" --regress "$BENCH_OUT" >/dev/null
 echo "smoke_telemetry: OK"

@@ -311,6 +311,30 @@ class TrainConfig:
     # save leaves a partial dir; readers already ignore it, this
     # reclaims the space). 0 = keep everything.
     keep_checkpoints: int = 0
+    # model-health signals (docs/OBSERVABILITY.md "Health metrics"):
+    # "norms" adds global grad-norm / update-norm / param-norm scalars to
+    # every step's metrics output (fused into the jitted step — one
+    # isfinite-style reduction per table, read back through the same
+    # one-step-behind block the StepTimer uses, so no sync bubble) plus a
+    # host-side loss EMA and live table-occupancy / collision-estimate
+    # gauges; "full" additionally emits per-table norms. "off" (default)
+    # leaves the step program untouched — zero overhead.
+    health_metrics: str = "off"
+    # loss-EMA decay for the health monitor (ema = d*ema + (1-d)*loss,
+    # seeded by the first finite loss; McMahan et al. 2013 monitor
+    # exactly this kind of smoothed online loss in production CTR)
+    health_ema_decay: float = 0.99
+    # liveness heartbeat JSONL ("" = off): one {step} record every
+    # heartbeat_every steps plus start/final events, stamped
+    # ts/rank/run_id/kind=heartbeat — the launcher watchdog and
+    # metrics_report --health read these to flag dead ranks/stragglers
+    heartbeat_path: str = ""
+    heartbeat_every: int = 25
+    # no-progress hang watchdog (0 = off): if no train step completes
+    # for this many seconds, dump ALL thread stacks to stderr once per
+    # stall (faulthandler), then re-arm when progress resumes. SIGUSR1
+    # stack dumps are always installed during fit() (main thread only).
+    hang_timeout_s: float = 0.0
 
 
 @dataclass(frozen=True)

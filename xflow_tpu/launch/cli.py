@@ -29,6 +29,19 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
                     help="dotted config override, e.g. --set optim.name=sgd")
 
 
+def _add_watchdog_flags(ap: argparse.ArgumentParser) -> None:
+    """Liveness-watchdog knobs shared by launch-local/launch-dist
+    (active with --run-dir; launch/watchdog.py). 0 = module default."""
+    ap.add_argument("--straggler-factor", type=float, default=0.0,
+                    help="flag a rank whose heartbeat step trails the leader "
+                         "by more than this factor (default 2.0)")
+    ap.add_argument("--dead-after-s", type=float, default=0.0,
+                    help="flag a rank with no heartbeat for this many "
+                         "seconds as dead (default 60)")
+    ap.add_argument("--watchdog-poll-s", type=float, default=0.0,
+                    help="heartbeat poll interval in seconds (default 2)")
+
+
 def _build_config(args) -> "Config":
     from xflow_tpu.config import Config, override
 
@@ -174,7 +187,9 @@ def cmd_launch_local(args) -> int:
     from xflow_tpu.launch.local import launch_local
 
     return launch_local(
-        args.num_processes, args.forward, port=args.port, run_dir=args.run_dir
+        args.num_processes, args.forward, port=args.port, run_dir=args.run_dir,
+        straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
+        watchdog_poll_s=args.watchdog_poll_s,
     )
 
 
@@ -197,6 +212,8 @@ def cmd_launch_dist(args) -> int:
         hosts, args.forward, port=args.port, ssh_cmd=args.ssh_cmd,
         workdir=args.workdir, python=args.python, env_extra=env_extra,
         dry_run=args.dry_run, run_dir=args.run_dir,
+        straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
+        watchdog_poll_s=args.watchdog_poll_s,
     )
 
 
@@ -280,6 +297,7 @@ def main(argv=None) -> int:
                          "train.metrics_path in the forwarded args) and all "
                          "ranks share one run_id; summarize with "
                          "tools/metrics_report.py")
+    _add_watchdog_flags(ll)
     ll.add_argument("forward", nargs=argparse.REMAINDER,
                     help="-- followed by `xflow train` args to run in every process")
     ll.set_defaults(fn=cmd_launch_local)
@@ -309,6 +327,7 @@ def main(argv=None) -> int:
                          "tools/metrics_report.py")
     ld.add_argument("--dry-run", action="store_true",
                     help="print the per-host command lines instead of running")
+    _add_watchdog_flags(ld)
     ld.add_argument("forward", nargs=argparse.REMAINDER,
                     help="-- followed by `xflow train` args to run on every host")
     ld.set_defaults(fn=cmd_launch_dist)

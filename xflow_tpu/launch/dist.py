@@ -132,6 +132,9 @@ def launch_dist(
     env_extra: dict | None = None,
     dry_run: bool = False,
     run_dir: str = "",
+    straggler_factor: float = 0.0,
+    dead_after_s: float = 0.0,
+    watchdog_poll_s: float = 0.0,
 ) -> int:
     """Start one rank per host over ssh and wait for all of them.
 
@@ -166,6 +169,40 @@ def launch_dist(
             print(f"# rank {i} on {h}:")
             print(f"{ssh_cmd} {h} {shlex.quote(c)}")
         return 0
+    watchdog = None
+    if run_dir:
+        # mirror launch_local: create the run dir from this seat so the
+        # recommended shared-filesystem setup works without
+        # pre-creation (on a non-shared path this just makes an unused
+        # local dir the watchdog watches quietly — no beats, no flags)
+        try:
+            os.makedirs(run_dir, exist_ok=True)
+        except OSError as e:
+            print(
+                f"launch-dist: cannot create run dir {run_dir!r} locally "
+                f"({e}); live watchdog disabled — run "
+                "`tools/metrics_report.py --health` on the collected "
+                "files afterwards",
+                file=sys.stderr,
+            )
+    if run_dir and os.path.isdir(run_dir):
+        # the run dir is visible from this seat (shared filesystem —
+        # the recommended setup): poll the ranks' heartbeat streams for
+        # dead ranks/stragglers, same watchdog launch-local runs
+        # (<= 0 knobs take the module defaults). A purely remote run
+        # dir skips this; run `metrics_report.py --health` on the
+        # collected files instead.
+        from xflow_tpu.launch.watchdog import RunWatchdog
+
+        watchdog = RunWatchdog(
+            run_dir,
+            num_ranks=len(hosts),
+            straggler_factor=straggler_factor,
+            dead_after_s=dead_after_s,
+            poll_s=watchdog_poll_s,
+            run_id=env_extra["XFLOW_RUN_ID"],
+        )
+        watchdog.start()
     procs = []
     grace_s = 10.0
 
@@ -226,3 +263,6 @@ def launch_dist(
         for p in procs:
             p.wait()
         raise
+    finally:
+        if watchdog is not None:
+            watchdog.stop()

@@ -26,16 +26,21 @@ def _free_port() -> int:
 
 
 def rank_metrics_args(run_dir: str, rank: int) -> list[str]:
-    """Extra `xflow train` args pointing rank `rank`'s metrics JSONL
-    into the run dir — ONE file per rank (two ranks appending to one
-    file would interleave mid-line under concurrent flush). Shared by
-    launch-local and launch-dist so the layout
-    (`<run_dir>/metrics_rank<k>.jsonl`, what tools/metrics_report.py
-    globs) is defined once."""
+    """Extra `xflow train` args pointing rank `rank`'s metrics AND
+    heartbeat JSONL into the run dir — ONE file per rank per stream
+    (two ranks appending to one file would interleave mid-line under
+    concurrent flush). Shared by launch-local and launch-dist so the
+    layout (`<run_dir>/metrics_rank<k>.jsonl` +
+    `<run_dir>/heartbeat_rank<k>.jsonl`, what tools/metrics_report.py
+    globs and the run watchdog polls) is defined once."""
     if not run_dir:
         return []
     path = os.path.join(run_dir, f"metrics_rank{rank}.jsonl")
-    return ["--set", f"train.metrics_path={path}"]
+    hb = os.path.join(run_dir, f"heartbeat_rank{rank}.jsonl")
+    return [
+        "--set", f"train.metrics_path={path}",
+        "--set", f"train.heartbeat_path={hb}",
+    ]
 
 
 def resolve_launch_run_id() -> str:
@@ -49,7 +54,13 @@ def resolve_launch_run_id() -> str:
 
 
 def launch_local(
-    num_processes: int, forward_args: list[str], port: int = 0, run_dir: str = ""
+    num_processes: int,
+    forward_args: list[str],
+    port: int = 0,
+    run_dir: str = "",
+    straggler_factor: float = 0.0,
+    dead_after_s: float = 0.0,
+    watchdog_poll_s: float = 0.0,
 ) -> int:
     if forward_args and forward_args[0] == "--":
         forward_args = forward_args[1:]
@@ -58,8 +69,23 @@ def launch_local(
     # one run id across all ranks: their metrics/quarantine JSONL
     # streams join on it (telemetry.resolve_run_id reads the env)
     run_id = resolve_launch_run_id()
+    watchdog = None
     if run_dir:
         os.makedirs(run_dir, exist_ok=True)
+        # liveness watchdog over the ranks' heartbeat streams: flags
+        # dead ranks and stragglers while the run is still going
+        # (launch/watchdog.py; <= 0 knobs take the module defaults)
+        from xflow_tpu.launch.watchdog import RunWatchdog
+
+        watchdog = RunWatchdog(
+            run_dir,
+            num_ranks=num_processes,
+            straggler_factor=straggler_factor,
+            dead_after_s=dead_after_s,
+            poll_s=watchdog_poll_s,
+            run_id=run_id,
+        )
+        watchdog.start()
     procs = []
     for rank in range(num_processes):
         env = dict(os.environ)
@@ -83,6 +109,10 @@ def launch_local(
         ]
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
-    for p in procs:
-        rc = p.wait() or rc
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
     return rc

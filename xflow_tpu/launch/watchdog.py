@@ -1,0 +1,271 @@
+"""Run-dir liveness watchdog: dead-rank and straggler detection.
+
+Each training rank appends heartbeat records —
+``{ts, rank, run_id, kind: "heartbeat", step}`` every
+``train.heartbeat_every`` steps plus start/interrupted/final events —
+to ``<run_dir>/heartbeat_rank<k>.jsonl`` (the path the launchers wire
+per rank, launch/local.py ``rank_metrics_args``). This module is the
+reader side, the Dapper-style cross-rank view the ROADMAP's
+serve-heavy-traffic north-star needs: instead of N per-rank log files
+someone greps after the fact, ONE watchdog in the launcher process
+polls the shared run dir and flags, while the job is still running:
+
+- **dead** ranks: no heartbeat for ``dead_after_s`` (a killed process,
+  a wedged host). SPMD corollary, stated plainly: once one rank stops
+  dispatching, its peers block in the next collective and go stale
+  ~2 steps later (the one-step-behind metrics block bounds how far a
+  host can run ahead), so on an all-stale cluster the LOWEST-step rank
+  is the culprit and the rest are victims — `classify` orders by step
+  so that reading is immediate.
+- **stragglers**: ranks whose last-seen step trails the leader by more
+  than ``straggler_factor``× (``max_step > factor * max(step, 1)``).
+
+Detection is heartbeat-file-only on purpose — the watchdog needs no
+channel into the ranks (works over any shared filesystem, exactly like
+the reference's operators tailing per-worker logs, minus the tailing).
+
+`metrics_report.py --health` reuses `classify` for the offline
+post-mortem view (with "now" = the newest heartbeat seen, so a
+finished run isn't all "dead").
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+DEFAULT_STRAGGLER_FACTOR = 2.0
+DEFAULT_DEAD_AFTER_S = 60.0
+DEFAULT_POLL_S = 2.0
+
+
+def fold_heartbeats(
+    records, beats: Optional[dict] = None, run_id: Optional[str] = None
+) -> dict:
+    """Fold heartbeat records into {rank: {"step", "ts", "event"}},
+    keeping the newest record per rank (a step-less event keeps the
+    rank's last known step). The ONE place this fold lives — the live
+    watchdog (`read_heartbeats`) and the offline post-mortem
+    (tools/metrics_report.py --health) both classify through it, so
+    they cannot drift. `run_id` filters to one launch — a reused
+    --run-dir appends a second run's beats to the same files, and
+    without the filter the OLD run's ranks would read as permanently
+    dead in the new run's live view."""
+    beats = {} if beats is None else beats
+    for rec in records:
+        rank = rec.get("rank")
+        ts = rec.get("ts")
+        if run_id is not None and rec.get("run_id") != run_id:
+            continue
+        if not isinstance(rank, int) or not isinstance(ts, (int, float)):
+            continue
+        cur = beats.get(rank)
+        if cur is None or ts >= cur["ts"]:
+            step = rec.get("step")
+            beats[rank] = {
+                "step": int(step) if isinstance(step, (int, float)) else (cur["step"] if cur else 0),
+                "ts": float(ts),
+                "event": rec.get("event"),
+            }
+    return beats
+
+
+def read_heartbeats(run_dir: str, run_id: Optional[str] = None) -> dict:
+    """{rank: {"step": int, "ts": float, "event": str|None}} — the
+    newest heartbeat per rank across ``heartbeat_rank*.jsonl`` in
+    `run_dir`, optionally restricted to one `run_id` (see
+    `fold_heartbeats`). Truncation-tolerant (a rank killed mid-append
+    must not blind the watchdog to its earlier beats)."""
+    from xflow_tpu.jsonl import read_jsonl
+
+    beats: dict = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, "heartbeat_rank*.jsonl"))):
+        fold_heartbeats(read_jsonl(path, warn=False), beats, run_id=run_id)
+    return beats
+
+
+def classify(
+    beats: dict,
+    now: float,
+    straggler_factor: float = DEFAULT_STRAGGLER_FACTOR,
+    dead_after_s: float = DEFAULT_DEAD_AFTER_S,
+    expected_ranks: Optional[int] = None,
+) -> list[dict]:
+    """One status row per rank, lowest step first (the culprit ordering).
+
+    Statuses: ``ok``; ``straggler`` (step lag beyond the factor);
+    ``dead`` (heartbeat older than `dead_after_s`, and not cleanly
+    finished — a rank whose LAST record is the ``final``/``interrupted``
+    event is done, not dead); ``starting`` (newest record is still the
+    ``start`` event — the rank is inside first-step compilation, which
+    on a real TPU takes minutes and must not read as dead/straggling;
+    heads-up cadence note: pick ``dead_after_s`` comfortably above
+    `heartbeat_every` steps' worth of wall time, or a healthy rank
+    reads dead between beats); ``missing`` (an expected rank that never
+    wrote a heartbeat at all). Dead wins over straggler."""
+    finished = {
+        r for r, b in beats.items() if b.get("event") in ("final", "interrupted")
+    }
+    starting = {r for r, b in beats.items() if b.get("event") == "start"}
+    max_step = max((b["step"] for b in beats.values()), default=0)
+    rows = []
+    for rank in sorted(beats, key=lambda r: (beats[r]["step"], r)):
+        b = beats[rank]
+        age = max(0.0, now - b["ts"])
+        lagging = max_step > straggler_factor * max(b["step"], 1)
+        if rank in finished:
+            status = "finished"
+        elif rank in starting:
+            status = "starting"
+        elif age > dead_after_s:
+            status = "dead"
+        elif lagging:
+            status = "straggler"
+        else:
+            status = "ok"
+        rows.append(
+            {
+                "rank": rank,
+                "step": b["step"],
+                "max_step": max_step,
+                "age_s": round(age, 3),
+                "status": status,
+            }
+        )
+    if expected_ranks is not None:
+        for rank in range(expected_ranks):
+            if rank not in beats:
+                rows.append(
+                    {
+                        "rank": rank,
+                        "step": 0,
+                        "max_step": max_step,
+                        # None, not inf: these rows serialize into
+                        # watchdog.jsonl, which stays strict JSON
+                        "age_s": None,
+                        "status": "missing",
+                    }
+                )
+    return rows
+
+
+class RunWatchdog:
+    """Launcher-side poller: warn on stderr (and append events to
+    ``<run_dir>/watchdog.jsonl``) whenever a rank's status degrades to
+    straggler/dead, and log the recovery when it comes back. Started by
+    ``launch-local``/``launch-dist`` when ``--run-dir`` is set; purely
+    observational — teardown policy stays with the launcher (launch-dist
+    already fail-fasts on a nonzero rank exit)."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        num_ranks: int,
+        straggler_factor: float = 0.0,
+        dead_after_s: float = 0.0,
+        poll_s: float = 0.0,
+        run_id: str = "",
+        out=None,
+    ):
+        from xflow_tpu.jsonl import JsonlAppender
+
+        self._run_dir = run_dir
+        self._n = num_ranks
+        # <= 0 means "module default" — the launchers and their CLI
+        # flags pass 0 straight through, so the sentinel resolution
+        # lives in ONE place
+        self._factor = float(straggler_factor) if straggler_factor > 0 else DEFAULT_STRAGGLER_FACTOR
+        self._dead_after = float(dead_after_s) if dead_after_s > 0 else DEFAULT_DEAD_AFTER_S
+        self._poll = max(float(poll_s), 0.05) if poll_s > 0 else DEFAULT_POLL_S
+        self._out = out  # test seam; defaults to sys.stderr
+        self._run_id = run_id
+        self._events = JsonlAppender(
+            os.path.join(run_dir, "watchdog.jsonl"),
+            # rank -1 = the launcher itself; kind separates the stream
+            stamp={"rank": -1, "run_id": run_id or "?", "kind": "watchdog"},
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = time.time()
+        self._reported: dict = {}  # rank -> last reported status
+        self.flagged: dict = {}  # rank -> worst status ever reported
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="xflow-run-watchdog"
+        )
+        self._thread.start()
+
+    def poll_once(self, now: Optional[float] = None) -> list[dict]:
+        """One scan (also the test seam): classify every rank and report
+        transitions."""
+        beats = read_heartbeats(self._run_dir, run_id=self._run_id or None)
+        t = time.time() if now is None else now
+        # "missing" needs a startup grace: ranks open their heartbeat
+        # streams hundreds of ms apart, and a poll landing between the
+        # first and last start beat must not flag the slower ranks. A
+        # rank is only "missing" once the run has both produced beats
+        # AND outlived the dead threshold since this watchdog started.
+        expect = (
+            self._n
+            if beats and (t - self._started) > min(self._dead_after, 30.0)
+            else None
+        )
+        rows = classify(
+            beats,
+            t,
+            straggler_factor=self._factor,
+            dead_after_s=self._dead_after,
+            expected_ranks=expect,
+        )
+        for row in rows:
+            status = row["status"]
+            prev = self._reported.get(row["rank"], "ok")
+            # event payload keys deliberately avoid "rank"/"step": those
+            # would collide with the appender's launcher stamp and the
+            # report tool's per-stream step-monotonicity gate
+            payload = {
+                "flagged_rank": row["rank"],
+                "at_step": row["step"],
+                "max_step": row["max_step"],
+                "age_s": row["age_s"],
+            }
+            if status in ("straggler", "dead", "missing") and status != prev:
+                self.flagged[row["rank"]] = status
+                self._events.append({"event": status, **payload})
+                beat = (
+                    f"last heartbeat {row['age_s']:.1f}s ago"
+                    if isinstance(row["age_s"], float)
+                    else "no heartbeat ever"
+                )
+                print(
+                    f"launch watchdog: rank {row['rank']} is a {status.upper()}"
+                    f" (step {row['step']} vs leader {row['max_step']}, {beat})",
+                    file=self._out or sys.stderr,
+                )
+            elif status in ("ok", "finished") and prev in ("straggler", "dead", "missing"):
+                self._events.append({"event": "recovered", **payload})
+                print(
+                    f"launch watchdog: rank {row['rank']} recovered "
+                    f"(step {row['step']})",
+                    file=self._out or sys.stderr,
+                )
+            self._reported[row["rank"]] = status
+        return rows
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self.poll_once()
+            except Exception as e:  # a torn read must not kill the poller
+                print(f"launch watchdog: scan failed: {e}", file=sys.stderr)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self._events.close()

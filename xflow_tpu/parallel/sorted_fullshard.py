@@ -73,7 +73,7 @@ from xflow_tpu.ops.sorted_table import (
 from xflow_tpu.parallel.compat import shard_map
 from xflow_tpu.parallel.mesh import DATA_AXIS, TABLE_AXIS
 from xflow_tpu.train.state import TrainState
-from xflow_tpu.train.step import guard_nonfinite, metrics_keys
+from xflow_tpu.train.step import guard_nonfinite, health_norms, metrics_keys
 
 FS_KEYS = ("fs_slots", "fs_row", "fs_mask", "fs_off")
 
@@ -554,6 +554,14 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
                     {tname: state.tables[tname]}, state.opt_state, {tname: grads}, cfg
                 )
             metrics = {"loss": loss, "rows": rows}
+            # health norms ride the same replicated-scalar contract as
+            # the guard flag (shared helper, train/step.py): sharded
+            # reductions + one psum, identical values on every rank
+            metrics.update(
+                health_norms(
+                    cfg, state.tables, new_tables, grads={tname: grads}
+                )
+            )
             # non-finite guard: update_ok computed from replicated loss +
             # the sharded updated leaves (the isfinite reduction GSPMDs to
             # shard-local alls + one psum) — every rank/device sees the

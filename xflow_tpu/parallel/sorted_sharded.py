@@ -53,7 +53,7 @@ from xflow_tpu.ops.sorted_table import (
 from xflow_tpu.parallel.compat import shard_map
 from xflow_tpu.parallel.mesh import DATA_AXIS, TABLE_AXIS
 from xflow_tpu.train.state import TrainState
-from xflow_tpu.train.step import guard_nonfinite, metrics_keys
+from xflow_tpu.train.step import guard_nonfinite, health_norms, metrics_keys
 
 
 def validate_sorted_sharded(cfg: Config, mesh: Mesh) -> None:
@@ -203,9 +203,12 @@ def make_sorted_sharded_train_step(
                 cfg,
             )
         metrics = {"loss": loss, "rows": rows}
-        # non-finite guard: same shared helper as every other engine
-        # (train/step.py guard_nonfinite) — the discard select runs on
-        # the sharded leaves, the flag is replicated
+        # health norms + non-finite guard: the shared helpers every
+        # engine uses (train/step.py) — reductions over the sharded
+        # leaves lower to shard-local sums + one psum, outputs replicated
+        metrics.update(
+            health_norms(cfg, state.tables, new_tables, grads={"wv": grads})
+        )
         return guard_nonfinite(
             cfg, state, TrainState(new_tables, new_opt, state.step + 1), metrics
         )

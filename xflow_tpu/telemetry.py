@@ -398,6 +398,255 @@ class StepTimer:
         return rec
 
 
+# ------------------------------------------------------------- HealthMonitor
+
+
+def estimate_collision_rate(distinct_slots: int, num_slots: int) -> float:
+    """Live collision-rate estimate from slot saturation.
+
+    The offline tool (xflow_tpu/tools/collisions.py) computes the exact
+    rate from distinct feature tokens, which the trainer never sees
+    (the parser hands it post-fold slots). But under uniform hashing the
+    expected distinct-slot count for n distinct keys is
+    d = S·(1 − (1 − 1/S)^n); inverting gives n̂ = ln(1 − d/S)/ln(1 − 1/S)
+    and the estimated rate 1 − d/n̂ — the same birthday math, driven by
+    what the trainer CAN observe. Exact at d→0, conservative near
+    saturation (d→S ⇒ rate→1)."""
+    S, d = int(num_slots), int(distinct_slots)
+    if d <= 0 or S <= 1:
+        return 0.0
+    if d >= S:
+        return 1.0
+    import math
+
+    n_hat = math.log1p(-d / S) / math.log1p(-1.0 / S)
+    return max(0.0, 1.0 - d / n_hat)
+
+
+class HealthMonitor:
+    """Host side of the model-health pipeline (train.health_metrics).
+
+    The step builders fuse grad/update/param norms into each step's
+    metrics dict (train/step.py health_norms); this class consumes them
+    ONE STEP BEHIND — `collect()` runs right after `StepTimer.dispatched`
+    has block_until_ready'd the previous step's metrics, so every read
+    here is a ready-buffer host copy, never a sync — and maintains what
+    only the host can: the loss EMA, the touched-slot bitmap behind the
+    occupancy/collision gauges, and the per-window values the trainer
+    folds into its metrics-JSONL records.
+
+    Thread-safety: `observe_batch` runs on the prefetch/plan thread
+    (trainer._with_arrays) while `collect`/`window_record` run on the
+    fit loop — the bitmap and window state are lock-protected.
+    """
+
+    KEYS = ("grad_norm", "update_norm", "param_norm")
+
+    def __init__(
+        self,
+        mode: str = "off",
+        ema_decay: float = 0.99,
+        registry: Optional[Registry] = None,
+        num_slots: int = 0,
+    ):
+        if mode not in ("off", "norms", "full"):
+            raise ValueError(f"health mode {mode!r}: expected off|norms|full")
+        self.enabled = mode != "off"
+        self.mode = mode
+        self._decay = float(ema_decay)
+        self._reg = registry or default_registry()
+        self._lock = threading.Lock()
+        self.loss_ema = float("nan")
+        self._pending = None  # a step's metrics awaiting the one-behind read
+        self._last: dict = {}  # last observed health floats
+        self._win_grad_max = float("nan")
+        self._seen = (
+            np.zeros(int(num_slots), dtype=bool)
+            if self.enabled and num_slots > 0
+            else None
+        )
+        self._num_slots = int(num_slots)
+
+    # ------------------------------------------------- step-metrics side
+    def staged(self, metrics) -> None:
+        """Stage a just-dispatched step's (async) metrics for the next
+        collect — mirrors the trainer's pending_ok bookkeeping."""
+        if self.enabled:
+            self._pending = metrics
+
+    def collect(self) -> None:
+        """Finish the PREVIOUS step: read its (ready) health scalars and
+        loss, fold the EMA, refresh the gauges. Call right after
+        StepTimer.dispatched — the block there made these reads free."""
+        if self._pending is None:
+            return
+        m = self._pending
+        self._pending = None
+        loss = float(m["loss"]) if "loss" in m else float("nan")
+        if loss == loss and abs(loss) != float("inf"):
+            self.loss_ema = (
+                loss
+                if self.loss_ema != self.loss_ema
+                else self._decay * self.loss_ema + (1.0 - self._decay) * loss
+            )
+            self._reg.gauge("health.loss_ema").set(self.loss_ema)
+        vals = {}
+        for key in self.KEYS:
+            if key in m:
+                vals[key] = float(m[key])
+                self._reg.gauge(f"health.{key}").set(vals[key])
+        if self.mode == "full":
+            for key in m:
+                if isinstance(key, str) and "." in key and key.split(".")[0] in (
+                    "grad_norm", "update_norm", "param_norm",
+                ):
+                    vals[key] = float(m[key])
+        with self._lock:
+            if vals:
+                self._last = vals
+                g = vals.get("grad_norm")
+                if g is not None and (
+                    self._win_grad_max != self._win_grad_max or g > self._win_grad_max
+                ):
+                    self._win_grad_max = g
+
+    def flush(self) -> None:
+        """End-of-data: the final step's metrics were just blocked on by
+        StepTimer.flush(), so this collect is still sync-free."""
+        self.collect()
+
+    # --------------------------------------------------- occupancy side
+    def observe_batch(self, slots, mask) -> None:
+        """Mark a training batch's masked slots as touched (called from
+        the plan/prefetch thread so the bitmap write overlaps device
+        compute). Drives the occupancy + collision-estimate gauges."""
+        if self._seen is None:
+            return
+        idx = np.asarray(slots)[np.asarray(mask) > 0]
+        with self._lock:
+            self._seen[idx] = True
+
+    # ------------------------------------------------------- windowing
+    def window_record(self) -> dict:
+        """The health fields for one metrics-JSONL window record: last
+        norm values + the window's grad-norm max, the loss EMA, and the
+        occupancy/collision gauges. Empty dict when nothing was
+        collected yet (step 1 under log_every=1 — the health read runs
+        one behind, like the StepTimer)."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            if not self._last and self.loss_ema != self.loss_ema:
+                return {}
+            fin = lambda v: round(v, 6) if v == v and abs(v) != float("inf") else None
+            rec = {
+                "grad_norm": fin(self._last.get("grad_norm", float("nan"))),
+                "grad_norm_max": fin(self._win_grad_max),
+                "update_norm": fin(self._last.get("update_norm", float("nan"))),
+                "param_norm": fin(self._last.get("param_norm", float("nan"))),
+                "loss_ema": fin(self.loss_ema),
+            }
+            if self.mode == "full":
+                tables: dict = {}
+                for key, v in self._last.items():
+                    if "." in key:
+                        kind, tname = key.split(".", 1)
+                        tables.setdefault(tname, {})[kind] = fin(v)
+                if tables:
+                    rec["health_tables"] = tables
+            self._win_grad_max = float("nan")
+            if self._seen is not None:
+                touched = int(np.count_nonzero(self._seen))
+                occ = touched / self._num_slots
+                est = estimate_collision_rate(touched, self._num_slots)
+                rec["slots_touched"] = touched
+                rec["table_occupancy"] = round(occ, 6)
+                rec["est_collision_rate"] = round(est, 6)
+                self._reg.gauge("health.slots_touched").set(touched)
+                self._reg.gauge("health.table_occupancy").set(occ)
+                self._reg.gauge("health.est_collision_rate").set(est)
+        return rec
+
+
+# ----------------------------------------------------------- liveness hooks
+
+
+def install_stack_dump_handler():
+    """Register faulthandler on SIGUSR1 so an operator can get all-thread
+    stack dumps from a live (or wedged) trainer with plain `kill -USR1`
+    — the standard "why is this rank stuck" tool. Returns a restore
+    callable; a no-op off the main thread (signal handlers can only be
+    installed there; non-main callers keep training, just without the
+    hook) and on platforms without SIGUSR1."""
+    try:
+        import faulthandler
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return lambda: None
+        sig = getattr(signal, "SIGUSR1", None)
+        if sig is None:
+            return lambda: None
+        faulthandler.register(sig, all_threads=True)
+        return lambda: faulthandler.unregister(sig)
+    except Exception:
+        return lambda: None
+
+
+class HangWatchdog:
+    """No-progress watchdog (train.hang_timeout_s): a daemon thread that
+    dumps ALL thread stacks to stderr (faulthandler) when `tick()` has
+    not been called for `timeout_s` — one dump per stall, re-armed by
+    the next tick, so a recovered pipeline can trip it again later.
+    A hang in an SPMD trainer usually means a peer died mid-collective
+    (docs/ROBUSTNESS.md); the dump shows exactly which collective."""
+
+    def __init__(self, timeout_s: float, out=None):
+        self._timeout = float(timeout_s)
+        self._out = out  # test seam; defaults to sys.stderr at dump time
+        self._last = time.perf_counter()
+        self._dumped = False
+        self._stop = threading.Event()
+        self._thread = None
+        self.dumps = 0
+        if self._timeout > 0:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="xflow-hang-watchdog"
+            )
+            self._thread.start()
+
+    def tick(self) -> None:
+        self._last = time.perf_counter()
+        self._dumped = False
+
+    def _run(self) -> None:
+        import faulthandler
+        import sys as _sys
+
+        poll = min(max(self._timeout / 4.0, 0.05), 5.0)
+        while not self._stop.wait(poll):
+            idle = time.perf_counter() - self._last
+            if idle > self._timeout and not self._dumped:
+                self._dumped = True
+                self.dumps += 1
+                out = self._out or _sys.stderr
+                print(
+                    f"xflow: hang watchdog: no step progress for "
+                    f"{idle:.1f}s (> train.hang_timeout_s="
+                    f"{self._timeout}); dumping all thread stacks",
+                    file=out,
+                )
+                try:
+                    faulthandler.dump_traceback(file=out, all_threads=True)
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
 # --------------------------------------------------------------- TraceWindow
 
 

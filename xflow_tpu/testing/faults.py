@@ -134,6 +134,34 @@ def corrupt_orbax_checkpoint(ckpt_dir: str, step: Optional[int] = None,
     return _apply(victim, mode, **kw)
 
 
+# ------------------------------------------------------------ pacing faults
+def fit_delays_from_env(rank: int) -> tuple[float, int, float]:
+    """(per_step_sleep_s, stall_step, stall_s) for this rank — the
+    straggler/hang drill injector the fit loop resolves ONCE at start
+    (zero per-step cost when unset).
+
+    Env contract (the launch-local watchdog drill exports these):
+    - XFLOW_FAULT_STEP_DELAY_S: sleep this long before EVERY step — a
+      persistently slow host.
+    - XFLOW_FAULT_STALL_S (+ XFLOW_FAULT_STALL_STEP, default 1): sleep
+      once, at that 1-based step — a rank that stops progressing while
+      its peers run ahead, the heartbeat watchdog's straggler signature.
+    - XFLOW_FAULT_DELAY_RANK: restrict either fault to one rank
+      (default: all ranks).
+    """
+    r = os.environ.get("XFLOW_FAULT_DELAY_RANK")
+    if r is not None:
+        try:
+            if int(r) != rank:
+                return 0.0, 0, 0.0
+        except ValueError:
+            return 0.0, 0, 0.0
+    delay = float(os.environ.get("XFLOW_FAULT_STEP_DELAY_S", 0) or 0)
+    stall = float(os.environ.get("XFLOW_FAULT_STALL_S", 0) or 0)
+    stall_step = int(os.environ.get("XFLOW_FAULT_STALL_STEP", 1) or 1)
+    return delay, stall_step, stall
+
+
 # ------------------------------------------------------------- data faults
 def poison_nan_batches(trainer, steps: Iterable[int],
                        value: float = float("nan")) -> None:

@@ -104,11 +104,82 @@ def guard_nonfinite(cfg: Config, state: TrainState, new_state: TrainState, metri
     return guarded, dict(metrics, update_ok=ok)
 
 
+def health_mode(cfg: Config) -> str:
+    """Validate train.health_metrics and return the mode."""
+    m = cfg.train.health_metrics
+    if m not in ("off", "norms", "full"):
+        raise ValueError(f"train.health_metrics={m!r}: expected off|norms|full")
+    return m
+
+
+def health_metric_keys(cfg: Config) -> tuple:
+    """The health-scalar keys every step's metrics dict carries under
+    this config: global grad/update/param norms ("norms"), plus
+    per-table norms ("full"). Derived from the model's table specs so
+    the four step builders and the sharded out_shardings pytrees agree
+    by construction."""
+    mode = health_mode(cfg)
+    if mode == "off":
+        return ()
+    keys = ["grad_norm", "update_norm", "param_norm"]
+    if mode == "full":
+        from xflow_tpu.models import get_model
+
+        for t in sorted(get_model(cfg.model.name).table_specs(cfg)):
+            keys += [f"grad_norm.{t}", f"update_norm.{t}", f"param_norm.{t}"]
+    return tuple(keys)
+
+
+def health_norms(cfg: Config, old_tables, new_tables, grads=None, grad_sq=None) -> dict:
+    """Health scalars for one step, fused into the jitted program.
+
+    Per table: squared grad norm (from `grads` arrays, or engine-supplied
+    `grad_sq` scalars where the table gradient never materializes — the
+    fused scatter+FTRL path passes the occurrence-space cotangent's
+    norm), squared update norm ||new − old||², squared param norm
+    ||new||². Emitted as sqrt'd scalars keyed by `health_metric_keys`.
+    Reductions are plain sums, so under GSPMD/shard_map-produced sharded
+    leaves they lower to shard-local reductions + one psum and every
+    rank sees identical replicated values — no host collective, same
+    cost model as the non-finite guard's isfinite sweep. Norms are taken
+    on the PROPOSED update, before the guard's discard select: a
+    discarded step's exploding grad norm is exactly the diagnostic the
+    health stream exists to show."""
+    mode = health_mode(cfg)
+    if mode == "off":
+        return {}
+    names = sorted(new_tables)
+    sqsum = lambda x: (x.astype(jnp.float32) ** 2).sum()
+    sq = {}
+    for name in names:
+        if grad_sq is not None and name in grad_sq:
+            sq[name] = jnp.asarray(grad_sq[name], jnp.float32)
+        elif grads is not None and name in grads:
+            sq[name] = sqsum(grads[name])
+        else:
+            sq[name] = jnp.float32(0.0)
+    upd = {n: sqsum(new_tables[n] - old_tables[n]) for n in names}
+    par = {n: sqsum(new_tables[n]) for n in names}
+    total = lambda d: jnp.sqrt(sum(d.values()))
+    out = {
+        "grad_norm": total(sq),
+        "update_norm": total(upd),
+        "param_norm": total(par),
+    }
+    if mode == "full":
+        for n in names:
+            out[f"grad_norm.{n}"] = jnp.sqrt(sq[n])
+            out[f"update_norm.{n}"] = jnp.sqrt(upd[n])
+            out[f"param_norm.{n}"] = jnp.sqrt(par[n])
+    return out
+
+
 def metrics_keys(cfg: Config) -> tuple:
     """The step-metrics dict keys under this config — the sharded step
-    builders derive their out_shardings pytrees from this so the guard's
-    extra flag never desyncs a jit contract."""
-    base = ("loss", "rows")
+    builders derive their out_shardings pytrees from this so neither the
+    guard's extra flag nor the health scalars ever desync a jit
+    contract."""
+    base = ("loss", "rows") + health_metric_keys(cfg)
     return base + (("update_ok",) if nonfinite_guard_on(cfg) else ())
 
 
@@ -212,11 +283,22 @@ def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
             d_occ, batch["sorted_slots"], batch["win_off"], table, st["n"], st["z"],
             K, cfg.optim.ftrl, cfg.data.sorted_bf16, pack,
         )
-    metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
-    return (
-        TrainState({tname: w_new}, {tname: {"n": n_new, "z": z_new}}, state.step + 1),
-        metrics,
+    new_state = TrainState(
+        {tname: w_new}, {tname: {"n": n_new, "z": z_new}}, state.step + 1
     )
+    metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
+    # the table gradient never materializes on this path (that is the
+    # point of the fusion) — the occurrence-space cotangent's norm
+    # stands in for the grad norm (equal when the batch's occurrences
+    # hit distinct slots; a divergence signal either way). update/param
+    # norms keep the pre-step table live, same price the guard pays.
+    metrics.update(
+        health_norms(
+            cfg, state.tables, new_state.tables,
+            grad_sq={tname: (d_occ.astype(jnp.float32) ** 2).sum()},
+        )
+    )
+    return new_state, metrics
 
 
 def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool = True,
@@ -269,6 +351,7 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
                 state.tables, state.opt_state, grads, cfg
             )
         metrics = {"loss": loss, "rows": batch["row_mask"].sum()}
+        metrics.update(health_norms(cfg, state.tables, new_tables, grads=grads))
         return guard_nonfinite(
             cfg, state, TrainState(new_tables, new_opt, state.step + 1), metrics
         )

@@ -28,9 +28,12 @@ from xflow_tpu.data.pipeline import batch_iterator, count_batches, prefetch
 from xflow_tpu.metrics import auc_logloss
 from xflow_tpu.models import get_model
 from xflow_tpu.telemetry import (
+    HangWatchdog,
+    HealthMonitor,
     StepTimer,
     TraceWindow,
     default_registry,
+    install_stack_dump_handler,
     resolve_run_id,
 )
 from xflow_tpu.optim import get_optimizer
@@ -306,6 +309,26 @@ class Trainer:
         self.metrics = MetricsLogger(
             cfg.train.metrics_path,
             stamp={"rank": self.rank, "run_id": self.run_id},
+        )
+        # model-health monitor (train.health_metrics, docs/OBSERVABILITY.md
+        # "Health metrics"): consumes the step builders' fused norm
+        # scalars one step behind, owns the loss EMA and the
+        # occupancy/collision gauges. Validated at CONSTRUCTION like the
+        # guard mode (identical config on every rank → rank-symmetric).
+        from xflow_tpu.train.step import health_mode
+
+        self._health = HealthMonitor(
+            mode=health_mode(cfg),
+            ema_decay=cfg.train.health_ema_decay,
+            num_slots=cfg.num_slots,
+        )
+        # liveness heartbeat (train.heartbeat_path): tiny {step} records
+        # the launcher watchdog and metrics_report --health read to flag
+        # dead ranks and stragglers; kind="heartbeat" keeps the stream
+        # distinct from metrics when both land in one run dir
+        self.heartbeat = JsonlAppender(
+            cfg.train.heartbeat_path,
+            stamp={"rank": self.rank, "run_id": self.run_id, "kind": "heartbeat"},
         )
         # validate the guard mode at CONSTRUCTION (identical config on
         # every rank → rank-symmetric), not on the first bad batch
@@ -614,11 +637,15 @@ class Trainer:
         counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
         return int(counts.max()), local
 
-    def _with_arrays(self, batch, with_plan: bool = True):
+    def _with_arrays(self, batch, with_plan: bool = True, track_health: bool = True):
         """(batch, step-input arrays) — validation + sorted-plan building
         happen HERE so that, wrapped in `prefetch`, the host-side sort
-        overlaps device compute instead of serializing with dispatch."""
+        overlaps device compute instead of serializing with dispatch.
+        Training batches also feed the health monitor's touched-slot
+        bitmap here (same overlap argument; eval passes skip it)."""
         self._check_batch(batch)
+        if track_health:
+            self._health.observe_batch(batch.slots, batch.mask)
         return batch, self._batch_arrays(batch, with_plan=with_plan)
 
     def _coordinated_batches(
@@ -627,6 +654,7 @@ class Trainer:
         with_plan: bool = True,
         enforce_bad_rows: bool = True,
         quarantine: bool = True,
+        track_health: bool = True,
     ):
         """Yield exactly the globally-agreed number of (batch, arrays)
         pairs for `path`, padding with fully-masked empty batches once
@@ -640,7 +668,9 @@ class Trainer:
         thread through to the bad-record monitor (eval passes count but
         never raise; only the first training pass quarantines)."""
 
-        prepare = lambda b: self._with_arrays(b, with_plan=with_plan)
+        prepare = lambda b: self._with_arrays(
+            b, with_plan=with_plan, track_health=track_health
+        )
 
         def feed():
             # a REAL generator (map objects have no close): prefetch's
@@ -730,9 +760,11 @@ class Trainer:
         try:
             return self._fit(train_path)
         finally:
-            # release the metrics handle even on abnormal exit; a later
-            # log() on this Trainer transparently reopens in append mode
+            # release the metrics/heartbeat handles even on abnormal
+            # exit; a later log() on this Trainer transparently reopens
+            # in append mode
             self.metrics.close()
+            self.heartbeat.close()
 
     def _fit(self, train_path: Optional[str] = None) -> TrainResult:
         cfg = self.cfg
@@ -750,6 +782,28 @@ class Trainer:
         trace.maybe_start_run()
         steptimer = StepTimer()
         registry = default_registry()
+        health = self._health
+        # operator stack dumps: `kill -USR1 <pid>` prints every thread's
+        # stack (main-thread-only; restored in the finally), and the
+        # optional no-progress watchdog dumps them automatically when no
+        # step completes for train.hang_timeout_s
+        dump_restore = install_stack_dump_handler()
+        hang = HangWatchdog(cfg.train.hang_timeout_s)
+        # straggler/stall drill injectors (testing/faults.py): env-gated,
+        # resolved ONCE here — zero per-step cost in real runs
+        from xflow_tpu.testing.faults import fit_delays_from_env
+
+        step_delay_s, stall_step, stall_s = fit_delays_from_env(self.rank)
+        hb_every = cfg.train.heartbeat_every
+        if cfg.train.eval_every and not cfg.data.test_path:
+            # the eval_every gate below requires a holdout; say so once
+            # instead of silently never producing eval_auc records
+            print(
+                "xflow: warning: train.eval_every is set but "
+                "data.test_path is empty — no streaming eval will run",
+                file=sys.stderr,
+            )
+        self.heartbeat.append({"event": "start", "step": 0})
         last_metrics = None
         sig_flag, sig_restore = self._install_signal_checkpoint()
         multiproc = jax.process_count() > 1
@@ -826,16 +880,31 @@ class Trainer:
                     self._coordinated_batches(path, quarantine=epoch == 0)
                 ):
                     trace.before_step(res.steps + 1)
+                    if step_delay_s:  # drill injector (testing/faults.py)
+                        time.sleep(step_delay_s)
                     arrays = self._resolve_fullshard_overflow(batch, arrays)
                     arrays = self._shard_batch(arrays)
                     self.state, m = self.train_step(self.state, arrays)
                     # finish the PREVIOUS step's timing: the block on its
                     # metrics overlaps this step's device execution, so
-                    # neither the timer nor the guard below adds a bubble
+                    # neither the timer, the health read, nor the guard
+                    # below adds a bubble
                     steptimer.dispatched(m, batch.num_rows)
+                    # the previous step's metrics are ready now — the
+                    # health scalars (norms, loss for the EMA) read free
+                    health.collect()
+                    health.staged(m)
+                    hang.tick()
                     last_metrics = m
                     res.steps += 1
                     res.examples += batch.num_rows
+                    if hb_every and res.steps % hb_every == 0:
+                        self.heartbeat.append({"step": res.steps})
+                    if stall_s and res.steps == stall_step:
+                        # one-shot stall (straggler drill): this rank
+                        # stops progressing while peers run ahead
+                        time.sleep(stall_s)
+                        stall_s = 0.0
                     # consume the PREVIOUS step's flag now that this
                     # step is dispatched — its device time hides the
                     # host read, so the guard adds no pipeline bubble
@@ -865,6 +934,9 @@ class Trainer:
                         # (telemetry.StepTimer; empty only at step 1
                         # under log_every=1 — timing runs one behind)
                         rec.update(steptimer.window_record())
+                        # health window: norms, loss EMA, occupancy /
+                        # collision gauges (one behind, like the timer)
+                        rec.update(health.window_record())
                         counters = registry.snapshot()
                         if counters:
                             rec["counters"] = counters
@@ -875,6 +947,7 @@ class Trainer:
                         and res.steps % cfg.train.checkpoint_every == 0
                     ):
                         self.save_checkpoint()
+                        hang.tick()  # a slow collective save is progress
                     if not multiproc or (sync_every and res.steps % sync_every == 0):
                         stop_sig = coordinated_signal()
                         if stop_sig:
@@ -885,9 +958,41 @@ class Trainer:
                 if not stop_sig:
                     if (epoch + 1) % 30 == 0:
                         print(f"epoch : {epoch}", file=sys.stderr)
-                    if cfg.train.eval_every and (epoch + 1) % cfg.train.eval_every == 0:
-                        auc, ll = self.evaluate(dump=False)
-                        self.metrics.log({"epoch": epoch, "eval_auc": auc, "eval_logloss": ll})
+                    if (
+                        cfg.train.eval_every
+                        and cfg.data.test_path
+                        and (epoch + 1) % cfg.train.eval_every == 0
+                    ):
+                        # mid-training holdout pass: STREAMING by default
+                        # (BucketAUC histograms, no global score sort —
+                        # the giant-eval-set path) so quality lands in
+                        # the metrics JSONL while the run is still going
+                        # an eval pass makes no train-step progress:
+                        # bracket it with ticks so a long (healthy)
+                        # holdout doesn't read as a hang — at most one
+                        # dump can fire, and only if the eval ITSELF
+                        # exceeds the timeout
+                        hang.tick()
+                        auc, ll = self.evaluate(dump=False, streaming=True)
+                        hang.tick()
+                        # strict JSON: a one-class shard's NaN AUC logs
+                        # as null, same convention as the guarded loss
+                        self.metrics.log(
+                            {
+                                "step": res.steps,
+                                "epoch": epoch,
+                                "eval_auc": auc if auc == auc else None,
+                                "eval_logloss": ll if ll == ll else None,
+                            }
+                        )
+                        # gauges only for finite values: a one-class eval
+                        # shard yields NaN AUC, and a NaN in the registry
+                        # snapshot would leak into the (strict-JSON)
+                        # counters dict
+                        if auc == auc:
+                            registry.gauge("health.eval_auc").set(auc)
+                        if ll == ll:
+                            registry.gauge("health.eval_logloss").set(ll)
                     # re-check AFTER the epoch eval too (an end-of-epoch
                     # coordination point): a signal landing there, or
                     # between sync cadences, must not be lost
@@ -895,6 +1000,14 @@ class Trainer:
                 if stop_sig:
                     res.interrupted = stop_sig
                     self.metrics.log({"interrupted": res.interrupted, "step": res.steps})
+                    self.heartbeat.append({"event": "interrupted", "step": res.steps})
+                    # flush-and-close BOTH sinks here, before the (slow,
+                    # collective) checkpoint save: if the grace period
+                    # expires mid-save and the process is KILLed, the
+                    # metrics/heartbeat tails are already on disk. Later
+                    # appends transparently reopen (JsonlAppender).
+                    self.metrics.close()
+                    self.heartbeat.close()
                     print(
                         f"signal {res.interrupted}: checkpointing at step "
                         f"{res.steps} and exiting",
@@ -937,10 +1050,14 @@ class Trainer:
                     res.last_loss = loss
         finally:
             sig_restore()
+            dump_restore()
+            hang.close()
             trace.close()
         # the final step's timing is still in flight (one behind); this
-        # block is the single end-of-data sync the timer adds
+        # block is the single end-of-data sync the timer adds — the
+        # health monitor's tail collect rides the same block
         steptimer.flush()
+        health.flush()
         res.seconds = time.perf_counter() - start
         # table occupancy: fraction of slots ever touched by a gradient —
         # the sparse-model health metric (SURVEY.md §5 "table-occupancy").
@@ -980,10 +1097,12 @@ class Trainer:
         }
         # tail window (steps since the last log tick) + run-total counters
         final_rec.update(steptimer.window_record())
+        final_rec.update(health.window_record())
         counters = registry.snapshot()
         if counters:
             final_rec["counters"] = counters
         self.metrics.log(final_rec)
+        self.heartbeat.append({"event": "final", "step": res.steps})
         if cfg.train.checkpoint_dir:
             self.save_checkpoint()
         return res
@@ -997,7 +1116,11 @@ class Trainer:
         return np.asarray(p_dev)
 
     def evaluate(
-        self, test_path: Optional[str] = None, dump: Optional[bool] = None, block: int = 0
+        self,
+        test_path: Optional[str] = None,
+        dump: Optional[bool] = None,
+        block: int = 0,
+        streaming: bool = False,
     ) -> tuple[float, float]:
         """Predict pass. Returns (auc, logloss); optionally dumps pred file.
 
@@ -1018,12 +1141,21 @@ class Trainer:
         the collective sequences across processes and deadlock. With
         buckets on, each rank dumps its OWN rows to ``pred_<rank>_*.txt``
         (the reference's per-worker files, `lr_worker.cc:74-78`).
+
+        `streaming=True` (the trainer's mid-training `eval_every` pass)
+        upgrades the auto default to the bucketed path even
+        single-process — a holdout pass DURING training should stream
+        rather than sort a growing global score vector — while an
+        explicit `train.eval_buckets` setting still wins (it's config,
+        hence rank-symmetric either way).
         """
         cfg = self.cfg
         path = test_path or shard_path(cfg.data.test_path, self.rank)
         dump = cfg.train.pred_dump if dump is None else dump
         multiproc = jax.process_count() > 1
         buckets = resolve_eval_buckets(cfg.train.eval_buckets, multiproc)
+        if streaming and buckets == 0 and cfg.train.eval_buckets < 0:
+            buckets = 65536
         if buckets:
             return self._evaluate_bucketed(path, buckets, dump, block)
         dump = dump and (not multiproc or self.rank == 0)
@@ -1031,7 +1163,7 @@ class Trainer:
         pctrs, labels = [], []
         for batch, arrays in self._coordinated_batches(
             path, with_plan=self._mesh_engine != "replicated",
-            enforce_bad_rows=False, quarantine=False,
+            enforce_bad_rows=False, quarantine=False, track_health=False,
         ):
             arrays = self._resolve_fullshard_overflow(batch, arrays)
             arrays = self._shard_batch(arrays)
@@ -1085,7 +1217,7 @@ class Trainer:
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         for batch, arrays in self._coordinated_batches(
             path, with_plan=self._mesh_engine != "replicated",
-            enforce_bad_rows=False, quarantine=False,
+            enforce_bad_rows=False, quarantine=False, track_health=False,
         ):
             arrays = self._resolve_fullshard_overflow(batch, arrays)
             arrays = self._shard_batch(arrays)
