@@ -1,0 +1,529 @@
+"""Elastic-recovery suite (docs/ROBUSTNESS.md "Elastic recovery"):
+supervised auto-restart, exact data-pipeline resume, rendezvous
+retry/backoff, restart-generation stamping, and the report tool's
+multi-generation segmentation.
+
+The acceptance drill — kill at step K + auto-restart consumes the same
+record sequence as an uninterrupted run — is proved two ways: the
+in-process parity test here (bitwise-equal final tables through a
+non-boundary abort), and the end-to-end launch-local SIGKILL drill
+(tests/test_launch_local.py::test_launch_local_supervised_auto_restart
+for the 2-process path, tools/smoke_elastic.sh for the CI gate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.data.synth import generate_shards
+from xflow_tpu.launch.supervise import backoff_delay, retry_call, supervise
+from xflow_tpu.testing.faults import abort_after_step, corrupt_npz_checkpoint
+from xflow_tpu.train.checkpoint import (
+    committed_steps,
+    data_state_path,
+    read_data_state,
+)
+from xflow_tpu.train.trainer import Trainer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_cfg(tmp_path, **kw):
+    base = {
+        "data.train_path": str(tmp_path / "train"),
+        "data.log2_slots": 12,
+        "data.batch_size": 100,
+        "data.max_nnz": 8,
+        "model.num_fields": 5,
+        "train.epochs": 2,
+        "train.pred_dump": False,
+    }
+    base.update(kw)
+    return override(Config(), **base)
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    generate_shards(
+        str(tmp_path / "train"), 1, 600, num_fields=5, ids_per_field=30, seed=0
+    )
+    return tmp_path
+
+
+# ----------------------------------------------------- data_state round trip
+def test_checkpoint_carries_versioned_data_state(dataset, tmp_path):
+    ck = tmp_path / "ck"
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": str(ck),
+                               "train.checkpoint_every": 5})
+    Trainer(cfg).fit()
+    steps = committed_steps(str(ck))
+    assert steps == [12, 10, 5]
+    # mid-run checkpoint: mid-stream position, not completed
+    ds5 = read_data_state(str(ck), 5)
+    assert ds5 == {
+        "version": 1, "epoch": 0, "batches": 5, "completed": False,
+        "examples": 500, "quarantined_rows": 0,
+    }
+    # final checkpoint: all epochs consumed, completed
+    ds12 = read_data_state(str(ck), 12)
+    assert ds12["completed"] and ds12["epoch"] == 2 and ds12["batches"] == 0
+    assert ds12["examples"] == 1200
+    # the metadata carries the version field (satellite: versioned format)
+    meta = json.load(open(ck / "step_12" / "meta.json"))
+    assert meta["version"] == 2
+
+
+def test_read_data_state_missing_downgrades(dataset, tmp_path, capsys):
+    """Satellite: a COMMITTED checkpoint without a data_state file (a
+    pre-PR-4 checkpoint) resumes with a fresh stream and a logged
+    downgrade — never an error."""
+    ck = tmp_path / "ck"
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": str(ck)})
+    Trainer(cfg).fit()
+    os.remove(data_state_path(str(ck), 12))
+    assert read_data_state(str(ck), 12) is None
+    assert "no data_state" in capsys.readouterr().err
+    # the resume itself still works: model restores, stream starts fresh
+    t2 = Trainer(cfg)
+    assert t2.maybe_restore() and int(t2.state.step) == 12
+    assert t2._consume_resume_position() == (0, 0)
+
+
+def test_read_data_state_truncated_downgrades(dataset, tmp_path, capsys):
+    """Satellite: corrupt_ckpt's data_state mode truncates the file;
+    the reader downgrades to a fresh stream instead of raising."""
+    ck = tmp_path / "ck"
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": str(ck)})
+    Trainer(cfg).fit()
+    corrupt_npz_checkpoint(str(ck), target="data_state", mode="truncate",
+                           keep_frac=0.3)
+    assert read_data_state(str(ck), 12) is None
+    assert "unreadable" in capsys.readouterr().err
+
+
+def test_corrupt_ckpt_cli_data_state_target(dataset, tmp_path):
+    """The operator drill tool reaches the new path end to end."""
+    ck = tmp_path / "ck"
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": str(ck)})
+    Trainer(cfg).fit()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "corrupt_ckpt.py"),
+         "--dir", str(ck), "--target", "data_state", "--mode", "truncate"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["corrupted"].endswith("step_12/data_state.json")
+    assert read_data_state(str(ck), 12) is None
+
+
+def test_data_state_walks_back_with_the_restored_step(dataset, tmp_path):
+    """A corrupt newest checkpoint walks restore back — and the stream
+    position must come from the step that actually restored, never the
+    newer (unreadable) one."""
+    ck = tmp_path / "ck"
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": str(ck),
+                               "train.checkpoint_every": 5})
+    Trainer(cfg).fit()
+    corrupt_npz_checkpoint(str(ck), step=12, mode="truncate")
+    corrupt_npz_checkpoint(str(ck), step=10, mode="truncate")
+    t2 = Trainer(cfg)
+    assert t2.maybe_restore()
+    assert int(t2.state.step) == 5
+    assert t2._resume_data_state["batches"] == 5  # step 5's position
+
+
+# --------------------------------------------------------- exact resume
+def test_resume_exact_stream_parity(dataset, tmp_path):
+    """THE parity gate: kill at a NON-boundary step (checkpoint at 5,
+    abort after 7 — steps 6-7 lost and retrained) + resume consumes the
+    same record sequence as an uninterrupted run: final tables and
+    optimizer state are bitwise-close and the step counts match.
+    Without data_state the resumed run would replay from row 0 and
+    train 17 steps instead of 12."""
+    cfg_ref = make_cfg(dataset, **{"train.checkpoint_dir": str(tmp_path / "ck_ref")})
+    t_ref = Trainer(cfg_ref)
+    assert t_ref.fit().steps == 12
+
+    ck = str(tmp_path / "ck")
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": ck,
+                               "train.checkpoint_every": 5})
+    t1 = Trainer(cfg)
+    abort_after_step(t1, 7)
+    with pytest.raises(RuntimeError, match="injected abort"):
+        t1.fit()
+    assert committed_steps(ck) == [5]
+
+    t2 = Trainer(cfg)
+    assert t2.maybe_restore() and int(t2.state.step) == 5
+    res = t2.fit()
+    assert res.steps == 7  # exactly the un-trained suffix (6..12)
+    assert int(t2.state.step) == 12
+    np.testing.assert_allclose(
+        np.asarray(t2.state.tables["w"]), np.asarray(t_ref.state.tables["w"]),
+        rtol=0, atol=1e-6,
+        err_msg="resumed stream != uninterrupted stream (record-sequence drift)",
+    )
+    np.testing.assert_allclose(
+        np.asarray(t2.state.opt_state["w"]["n"]),
+        np.asarray(t_ref.state.opt_state["w"]["n"]),
+        rtol=0, atol=1e-6,
+    )
+    # cumulative accounting: 7 trained-then-lost-then-retrained... no —
+    # 5 kept + 2 retrained + 5 fresh: 500 (gen 0's committed view) +
+    # 700 consumed by the resumed fit
+    ds = read_data_state(ck, 12)
+    assert ds["completed"] and ds["examples"] == 1200
+
+
+def test_resume_mid_later_epoch(dataset, tmp_path):
+    """The epoch component matters too: abort inside epoch 1 (step 9 =
+    epoch 1, batch 3); resume continues at that exact (epoch, batch)."""
+    ck = str(tmp_path / "ck")
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": ck,
+                               "train.checkpoint_every": 8})
+    t1 = Trainer(cfg)
+    abort_after_step(t1, 9)
+    with pytest.raises(RuntimeError, match="injected abort"):
+        t1.fit()
+    assert committed_steps(ck) == [8]
+    assert read_data_state(ck, 8) == {
+        "version": 1, "epoch": 1, "batches": 2, "completed": False,
+        "examples": 800, "quarantined_rows": 0,
+    }
+    t2 = Trainer(cfg)
+    assert t2.maybe_restore()
+    res = t2.fit()
+    assert res.steps == 4 and int(t2.state.step) == 12
+
+
+def test_resume_restores_this_ranks_example_counter(dataset):
+    """On ragged shards the per-rank consumed-example counts differ;
+    each rank must restore ITS OWN counter from examples_per_rank, not
+    adopt the writer's (rank 0's) scalar — that would inflate every
+    later checkpoint's accounting on the shorter ranks."""
+    t = Trainer(make_cfg(dataset), process_index=1)
+    t._resume_data_state = {
+        "version": 1, "epoch": 0, "batches": 10, "completed": False,
+        "examples": 1000, "examples_per_rank": [1000, 900],
+    }
+    assert t._consume_resume_position() == (0, 10)
+    assert t._examples_seen == 900
+    # single-process / legacy data_state: the scalar is this rank's own
+    t2 = Trainer(make_cfg(dataset))
+    t2._resume_data_state = {
+        "version": 1, "epoch": 1, "batches": 2, "completed": False,
+        "examples": 800,
+    }
+    assert t2._consume_resume_position() == (1, 2)
+    assert t2._examples_seen == 800
+
+
+def test_completed_checkpoint_restarts_fresh_pass(dataset, tmp_path):
+    """Continuation training (pinned by test_trainer.py): resuming a
+    COMPLETED run's checkpoint starts a fresh pass instead of training
+    nothing — the `completed` flag is the discriminator."""
+    ck = str(tmp_path / "ck")
+    cfg = make_cfg(dataset, **{"train.checkpoint_dir": ck})
+    Trainer(cfg).fit()
+    t2 = Trainer(cfg)
+    assert t2.maybe_restore()
+    assert t2._consume_resume_position() == (0, 0)
+
+
+def test_skip_batches_fast_forward(dataset):
+    """The pipeline seam: skip=N yields exactly the stream's suffix —
+    same labels, same order — and the monitor never sees the prefix."""
+    from xflow_tpu.data.pipeline import batch_iterator
+
+    cfg = make_cfg(dataset).data
+    shard = str(dataset / "train-00000")
+    full = [np.asarray(b.labels) for b in batch_iterator(shard, cfg)]
+    tail = [np.asarray(b.labels) for b in batch_iterator(shard, cfg, skip=4)]
+    assert len(tail) == len(full) - 4
+    for a, b in zip(tail, full[4:]):
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------- supervision loop
+def test_supervise_restarts_until_success():
+    rcs = iter([3, 2, 0])
+    gens, naps = [], []
+
+    def attempt(gen):
+        gens.append(gen)
+        return next(rcs)
+
+    rc = supervise(attempt, max_restarts=5, restart_backoff=0.5,
+                   sleep=naps.append, clock=lambda: 0.0)
+    assert rc == 0 and gens == [0, 1, 2]
+    assert len(naps) == 2
+    # exponential with jitter: delay k in [0.5, 1.0] * base * 2^k
+    assert 0.25 <= naps[0] <= 0.5 and 0.5 <= naps[1] <= 1.0
+
+
+def test_supervise_budget_exhausted_returns_last_rc():
+    rc = supervise(lambda gen: 7, max_restarts=2, restart_backoff=0.0,
+                   sleep=lambda s: None, clock=lambda: 0.0)
+    assert rc == 7
+
+
+def test_supervise_zero_restarts_is_single_attempt():
+    calls = []
+    rc = supervise(lambda gen: calls.append(gen) or 9, max_restarts=0)
+    assert rc == 9 and calls == [0]
+
+
+def test_supervise_min_uptime_stops_crash_loops():
+    clock = iter([0.0, 0.5])  # attempt "ran" 0.5s < min_uptime 2.0
+    calls = []
+    rc = supervise(lambda gen: calls.append(gen) or 5, max_restarts=3,
+                   min_uptime_s=2.0, sleep=lambda s: None,
+                   clock=lambda: next(clock))
+    assert rc == 5 and calls == [0]  # config error: no restart burned
+
+
+def test_backoff_delay_caps_and_jitters():
+    class FixedRng:
+        def uniform(self, a, b):
+            return b  # upper edge
+
+    assert backoff_delay(0, 1.0, rng=FixedRng()) == 1.0
+    assert backoff_delay(3, 1.0, rng=FixedRng()) == 8.0
+    assert backoff_delay(20, 1.0, cap_s=60.0, rng=FixedRng()) == 60.0
+    d = backoff_delay(2, 1.0)
+    assert 2.0 <= d <= 4.0
+
+
+def test_retry_call_retries_then_succeeds():
+    attempts, cleanups = [], []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ConnectionError("coordinator not up yet")
+        return "joined"
+
+    got = retry_call(flaky, "rendezvous", retries=3, base_s=0.0,
+                     cleanup=lambda: cleanups.append(1), sleep=lambda s: None)
+    assert got == "joined" and len(attempts) == 3 and len(cleanups) == 2
+
+
+def test_retry_call_exhausted_raises_last():
+    def always():
+        raise ConnectionError("still down")
+
+    with pytest.raises(ConnectionError, match="still down"):
+        retry_call(always, "rendezvous", retries=2, base_s=0.0,
+                   sleep=lambda s: None)
+
+
+def test_rendezvous_retry_env_parses_defensively(monkeypatch):
+    from xflow_tpu.parallel.distributed import _rendezvous_retry_env
+
+    assert _rendezvous_retry_env() == (3, 1.0)
+    monkeypatch.setenv("XFLOW_RENDEZVOUS_RETRIES", "5")
+    monkeypatch.setenv("XFLOW_RENDEZVOUS_BACKOFF_S", "0.25")
+    assert _rendezvous_retry_env() == (5, 0.25)
+    monkeypatch.setenv("XFLOW_RENDEZVOUS_RETRIES", "garbage")
+    assert _rendezvous_retry_env()[0] == 3
+
+
+# ------------------------------------------------- generations & watchdog
+def test_gen_stamp_in_every_jsonl_record(tmp_path, monkeypatch):
+    from xflow_tpu.jsonl import JsonlAppender
+
+    path = tmp_path / "m.jsonl"
+    monkeypatch.setenv("XFLOW_RESTART_GEN", "2")
+    ap = JsonlAppender(str(path), stamp={"rank": 0, "run_id": "r"})
+    ap.append({"step": 1})
+    ap.close()
+    rec = json.loads(open(path).read())
+    assert rec["gen"] == 2 and rec["rank"] == 0
+
+
+def test_kill_injector_env_gating(monkeypatch):
+    from xflow_tpu.testing.faults import kill_step_from_env
+
+    assert kill_step_from_env(0) == 0
+    monkeypatch.setenv("XFLOW_FAULT_KILL_STEP", "7")
+    assert kill_step_from_env(0) == 7
+    monkeypatch.setenv("XFLOW_FAULT_KILL_RANK", "1")
+    assert kill_step_from_env(0) == 0 and kill_step_from_env(1) == 7
+    # a restarted generation must NOT die again
+    monkeypatch.setenv("XFLOW_RESTART_GEN", "1")
+    assert kill_step_from_env(1) == 0
+    monkeypatch.setenv("XFLOW_FAULT_KILL_GEN", "1")
+    assert kill_step_from_env(1) == 7
+
+
+def test_watchdog_on_dead_policy(tmp_path):
+    """The escalation seam: a rank going dead fires the pluggable
+    on_dead exactly once per transition, with the status row."""
+    from xflow_tpu.launch.watchdog import RunWatchdog
+
+    hb = tmp_path / "heartbeat_rank0.jsonl"
+    with open(hb, "w") as f:
+        # a STALE beat from the previous generation: the gen-1 watchdog
+        # must ignore it (it would otherwise re-fire the dead policy
+        # before the relaunched rank's first beat — a teardown loop)
+        f.write(json.dumps({"ts": 900.0, "rank": 0, "run_id": "r",
+                            "kind": "heartbeat", "gen": 0, "step": 9}) + "\n")
+        f.write(json.dumps({"ts": 1000.0, "rank": 0, "run_id": "r",
+                            "kind": "heartbeat", "gen": 1, "step": 3}) + "\n")
+    fired = []
+    wd = RunWatchdog(str(tmp_path), num_ranks=1, dead_after_s=10.0,
+                     run_id="r", out=open(os.devnull, "w"),
+                     on_dead=fired.append, gen=1)
+    try:
+        rows = wd.poll_once(now=1005.0)  # fresh (gen-1 beat): ok
+        assert fired == [] and rows[0]["step"] == 3  # gen-0 beat ignored
+        wd.poll_once(now=1100.0)  # stale: dead -> policy fires once
+        wd.poll_once(now=1101.0)  # still dead: no re-fire
+    finally:
+        wd.stop()
+    assert len(fired) == 1
+    assert fired[0]["rank"] == 0 and fired[0]["status"] == "dead"
+    # the watchdog's own events carry the launcher-provided generation
+    events = [json.loads(l) for l in open(tmp_path / "watchdog.jsonl")]
+    assert events and all(e["gen"] == 1 for e in events)
+
+
+def test_watchdog_on_dead_error_does_not_kill_poller(tmp_path):
+    from xflow_tpu.launch.watchdog import RunWatchdog
+
+    hb = tmp_path / "heartbeat_rank0.jsonl"
+    with open(hb, "w") as f:
+        f.write(json.dumps({"ts": 1000.0, "rank": 0, "run_id": "r",
+                            "kind": "heartbeat", "gen": 0, "step": 3}) + "\n")
+
+    def boom(row):
+        raise RuntimeError("policy bug")
+
+    wd = RunWatchdog(str(tmp_path), num_ranks=1, dead_after_s=10.0,
+                     run_id="r", out=open(os.devnull, "w"), on_dead=boom)
+    try:
+        rows = wd.poll_once(now=1100.0)  # must not raise
+    finally:
+        wd.stop()
+    assert rows[0]["status"] == "dead"
+
+
+# ------------------------------------------------- report-tool segmentation
+def _rec(run_id, rank, gen, step, ts):
+    return {"ts": ts, "rank": rank, "run_id": run_id, "gen": gen,
+            "step": step, "loss": 0.5, "examples": step * 10,
+            "elapsed_s": float(step), "steps_per_s": 1.0, "rows_per_s": 10.0,
+            "step_time_p50_ms": 1.0, "step_time_p99_ms": 2.0,
+            "data_wait_ms": 0.1, "dispatch_ms": 0.1, "device_ms": 0.8}
+
+
+def test_check_accepts_multi_generation_stream(tmp_path):
+    """A supervised restart resets the step counter inside one run_id;
+    keyed on gen the stream passes --check, stripped of gen it would
+    trip the step-monotonicity gate — both directions pinned."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    import metrics_report
+
+    path = tmp_path / "metrics_rank0.jsonl"
+    recs = [_rec("r", 0, 0, 5, 1.0), _rec("r", 0, 0, 10, 2.0),
+            _rec("r", 0, 1, 2, 3.0), _rec("r", 0, 1, 4, 4.0)]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    streams, _ = metrics_report.load_streams([str(path)])
+    assert set(streams) == {("r", 0, "metrics", 0), ("r", 0, "metrics", 1)}
+    assert metrics_report.check_streams(streams, [str(path)]) == []
+
+    # negative control: the same records WITHOUT the gen stamp collapse
+    # into one stream whose steps go backwards
+    flat = tmp_path / "flat.jsonl"
+    with open(flat, "w") as f:
+        for r in recs:
+            r = dict(r)
+            r.pop("gen")
+            f.write(json.dumps(r) + "\n")
+    streams2, _ = metrics_report.load_streams([str(flat)])
+    problems = metrics_report.check_streams(streams2, [str(flat)])
+    assert any("step went backwards" in p for p in problems)
+
+
+def test_bench_record_sums_generations(tmp_path):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    import metrics_report
+
+    path = tmp_path / "metrics_rank0.jsonl"
+    g0 = _rec("r", 0, 0, 30, 1.0)
+    g0["eval_auc"], g0["eval_logloss"] = 0.80, 0.5
+    g1 = _rec("r", 0, 1, 20, 2.0)
+    g1["eval_auc"], g1["eval_logloss"] = 0.74, 0.6
+    with open(path, "w") as f:
+        for r in [g0, g1]:
+            f.write(json.dumps(r) + "\n")
+    streams, _ = metrics_report.load_streams([str(path)])
+    rec = metrics_report.bench_record(streams)
+    assert rec["steps"] == 50  # 30 (gen 0) + 20 (gen 1)
+    assert rec["examples"] == 500 and rec["generations"] == 2
+    assert rec["elapsed_s"] == 50.0  # per-gen elapsed sums
+    # quality = the NEWEST generation's model (what actually ships) —
+    # a superseded gen-0 AUC must not satisfy --regress
+    assert rec["auc"] == 0.74
+
+
+def test_fold_heartbeats_tolerates_damaged_gen():
+    """One record with a junk gen (string, NaN) must be skipped, not
+    raise and blind every later watchdog scan."""
+    from xflow_tpu.launch.watchdog import fold_heartbeats
+
+    recs = [
+        {"ts": 1.0, "rank": 0, "run_id": "r", "gen": "x", "step": 1},
+        {"ts": 2.0, "rank": 0, "run_id": "r", "gen": float("nan"), "step": 2},
+        {"ts": 3.0, "rank": 0, "run_id": "r", "gen": 1, "step": 3},
+    ]
+    beats = fold_heartbeats(recs, run_id="r", gen=1)
+    assert beats == {0: {"step": 3, "ts": 3.0, "event": None}}
+
+
+def test_heartbeat_brackets_eval_and_checkpoint(dataset, tmp_path):
+    """A quiet eval/checkpoint phase must not age into a dead verdict
+    (under supervision that verdict is a TEARDOWN): the trainer
+    brackets both with heartbeat events."""
+    hb = tmp_path / "heartbeat_rank0.jsonl"
+    generate_shards(str(dataset / "test"), 1, 100, num_fields=5,
+                    ids_per_field=30, seed=7, truth_seed=0)
+    cfg = make_cfg(dataset, **{
+        "train.heartbeat_path": str(hb),
+        "train.checkpoint_dir": str(tmp_path / "ck"),
+        "train.checkpoint_every": 5,
+        "train.eval_every": 1,
+        "data.test_path": str(dataset / "test"),
+    })
+    Trainer(cfg).fit()
+    events = [r.get("event") for r in map(json.loads, open(hb))]
+    assert "checkpoint" in events and "eval" in events and "final" in events
+
+
+# ----------------------------------------------------------- CI smoke gate
+def test_smoke_elastic_script(tmp_path):
+    """The elastic-recovery CI gate end to end: clean supervised run +
+    bench datapoint + kill-and-recover drill with exact accounting
+    (tools/smoke_elastic.sh; the acceptance criterion's drill)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        ["bash", os.path.join(REPO_ROOT, "tools", "smoke_elastic.sh"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=570, env=env,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "smoke_elastic: OK" in r.stdout
+    assert "kill drill accounting OK" in r.stdout
+    # the bench datapoint landed in the workdir (never the repo root
+    # from pytest), carrying the clean run's steady-state throughput
+    bench = json.load(open(tmp_path / "BENCH_r07.json"))
+    assert bench["metric"] == "telemetry_examples_per_sec"
+    assert bench["steps"] == 50 and bench["value"] > 0

@@ -251,6 +251,69 @@ def test_launch_local_ragged_and_missing_shards(tmp_path):
     assert json.loads(r.stdout.strip().splitlines()[-1])["steps"] == 3
 
 
+def test_launch_local_supervised_auto_restart(tmp_path):
+    """Elastic-recovery drill (PR 4 acceptance): SIGKILL rank 1 mid-run
+    (the env-gated kill injector, testing/faults.py) under
+    --max-restarts — the launcher must tear the job down, auto-restart
+    it WITHOUT operator action, restore the last committed checkpoint,
+    resume the data stream at the stored offset, and finish with the
+    exact total example count (the kill lands on a checkpoint boundary,
+    so no step is retrained: every row trains exactly once across the
+    two generations). metrics_report --check must accept the resulting
+    multi-generation stream."""
+    require_multiproc_cpu()
+    B, rows = 32, 96  # 3 batches/rank/epoch x 2 epochs = 6 global steps
+    generate_shards(str(tmp_path / "train"), 2, rows, num_fields=4, ids_per_field=50)
+    run_dir = tmp_path / "run"
+    r = run_cli(
+        ["launch-local", "--num-processes", "2",
+         "--max-restarts", "1", "--restart-backoff", "0.2",
+         "--run-dir", str(run_dir), "--",
+         "--train", str(tmp_path / "train"), "--batch-size", str(B),
+         "--checkpoint-dir", str(tmp_path / "ckpt"),
+         "--set", "train.checkpoint_every=2",
+         "--set", "train.heartbeat_every=1",
+         "--set", "train.log_every=1",
+         *TRAIN_ARGS],
+        tmp_path,
+        # kill rank 1 the moment step 4 completes — right after its
+        # checkpoint committed (generation-gated: the relaunch survives)
+        extra_env={"XFLOW_FAULT_KILL_STEP": "4", "XFLOW_FAULT_KILL_RANK": "1"},
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "hard-killing rank 1 at step 4" in r.stderr
+    assert "restarting generation 1" in r.stderr
+    assert "resumed from step 4" in r.stderr
+    assert "resuming data stream at epoch 1, batch offset 1" in r.stderr
+    assert "job succeeded after 1 restart(s)" in r.stderr
+
+    # generation 1's rank-0 summary: exactly the un-trained suffix
+    summaries = [json.loads(l) for l in r.stdout.strip().splitlines()
+                 if l.startswith("{")]
+    assert summaries and summaries[-1]["steps"] == 2  # steps 5, 6
+
+    # the final checkpoint is the full run, and its data_state accounts
+    # for every row exactly once on BOTH ranks (no replay, no loss)
+    from xflow_tpu.train.checkpoint import latest_step, read_data_state
+
+    ck = str(tmp_path / "ckpt")
+    assert latest_step(ck) == 6
+    ds = read_data_state(ck, 6)
+    assert ds["completed"] and ds["examples_per_rank"] == [2 * rows, 2 * rows]
+
+    # both generations landed in the run dir under ONE run_id, and the
+    # schema gate accepts the multi-generation stream
+    recs = [json.loads(l) for l in open(run_dir / "metrics_rank0.jsonl")]
+    assert {r_["gen"] for r_ in recs} == {0, 1}
+    assert len({r_["run_id"] for r_ in recs}) == 1
+    chk = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "metrics_report.py"),
+         str(run_dir), "--check"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert chk.returncode == 0, chk.stderr
+
+
 @pytest.mark.parametrize("engine", ["fullshard", "replicated"])
 def test_launch_local_two_process_sorted_engine(tmp_path, engine):
     """Multi-process sorted engines: 2 processes × 1 device, mesh

@@ -403,11 +403,13 @@ def test_metrics_report_summary_and_check(run_jsonl, tmp_path):
     assert r.returncode == 0, r.stderr
     lines = r.stdout.strip().splitlines()
     assert lines[0].split() == [
-        "run_id", "rank", "steps", "examples", "elapsed_s", "ex/s", "rows/s",
-        "p50_ms", "p99_ms", "wait_ms", "loss", "bad_steps", "bad_rows", "auc",
+        "run_id", "rank", "gen", "steps", "examples", "elapsed_s", "ex/s",
+        "rows/s", "p50_ms", "p99_ms", "wait_ms", "loss", "bad_steps",
+        "bad_rows", "auc",
     ]
     row = lines[2].split()
-    assert row[1] == "0" and row[2] == "30" and row[3] == "1920"
+    assert row[1] == "0" and row[2] == "0"  # rank 0, generation 0
+    assert row[3] == "30" and row[4] == "1920"
 
 
 def test_metrics_report_tolerates_truncation(run_jsonl, tmp_path):

@@ -47,6 +47,13 @@ def main(argv=None) -> int:
     ap.add_argument("--step", type=int, default=None,
                     help="step to corrupt (default: newest committed)")
     ap.add_argument("--mode", default="truncate", choices=("truncate", "bitflip"))
+    ap.add_argument("--target", default=None,
+                    help="which file of the checkpoint to corrupt: npz "
+                         "state|data_state (default state), orbax "
+                         "manifest|largest|data_state (default manifest). "
+                         "data_state drills the exact-resume downgrade: the "
+                         "model still restores, the stream restarts fresh "
+                         "with a logged warning")
     ap.add_argument("--keep-frac", type=float, default=0.5,
                     help="truncate: fraction of bytes to keep")
     ap.add_argument("--offset", type=int, default=None,
@@ -67,10 +74,12 @@ def main(argv=None) -> int:
         path = args.file
     elif args.format == "orbax":
         path = corrupt_orbax_checkpoint(args.dir, step=args.step,
-                                        mode=args.mode, **kw)
+                                        mode=args.mode,
+                                        target=args.target or "manifest", **kw)
     else:
         path = corrupt_npz_checkpoint(args.dir, step=args.step,
-                                      mode=args.mode, **kw)
+                                      mode=args.mode,
+                                      target=args.target or "state", **kw)
     print(json.dumps({"corrupted": path, "mode": args.mode,
                       "size": os.path.getsize(path)}))
     return 0

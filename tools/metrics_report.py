@@ -85,10 +85,25 @@ def expand_paths(paths: list[str]) -> list[str]:
     return out
 
 
+def _gen_of(rec: dict) -> int:
+    """Restart generation of a record (0 for pre-elastic streams and
+    for damaged values — a string or NaN gen must group, not raise)."""
+    g = rec.get("gen", 0)
+    try:
+        return int(g) if isinstance(g, (int, float)) else 0
+    except (ValueError, OverflowError):  # NaN/inf floats
+        return 0
+
+
 def load_streams(files: list[str]) -> tuple[dict, int]:
-    """{(run_id, rank, kind): [records in file order]} across all files,
-    plus the total damaged-line count. `kind` defaults to "metrics" for
-    unstamped legacy streams; heartbeat/watchdog records stamp theirs."""
+    """{(run_id, rank, kind, gen): [records in file order]} across all
+    files, plus the total damaged-line count. `kind` defaults to
+    "metrics" for unstamped legacy streams; heartbeat/watchdog records
+    stamp theirs. `gen` is the restart generation (elastic recovery):
+    a supervised auto-restart relaunches the job under the SAME run_id
+    with step counters back at 0, so every per-stream gate (step
+    monotonicity above all) keys on the generation — one launch's
+    restarts segment instead of reading as corruption."""
     streams: dict = {}
     skipped_total = 0
     for path in files:
@@ -99,16 +114,17 @@ def load_streams(files: list[str]) -> tuple[dict, int]:
                 str(rec.get("run_id", "?")),
                 rec.get("rank", "?"),
                 str(rec.get("kind", "metrics")),
+                _gen_of(rec),
             )
             streams.setdefault(key, []).append(rec)
     return streams, skipped_total
 
 
 def metrics_streams(streams: dict) -> dict:
-    """The (run_id, rank) -> records subset holding trainer metrics."""
+    """The (run_id, rank, gen) -> records subset holding trainer metrics."""
     return {
-        (rid, rank): recs
-        for (rid, rank, kind), recs in streams.items()
+        (rid, rank, gen): recs
+        for (rid, rank, kind, gen), recs in streams.items()
         if kind == "metrics"
     }
 
@@ -190,8 +206,10 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
     problems: list[str] = []
     if not streams:
         problems.append(f"no records in {', '.join(files)}")
-    for (run_id, rank, kind), records in sorted(streams.items(), key=str):
-        tag = f"run {run_id} rank {rank} [{kind}]"
+    for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
+        tag = f"run {run_id} rank {rank} [{kind}]" + (
+            f" gen {gen}" if gen else ""
+        )
         last_step = -1
         step_recs = 0
         window_recs = 0
@@ -250,7 +268,7 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
 
 def render_table(rows: list[tuple]) -> str:
     header = (
-        "run_id", "rank", "steps", "examples", "elapsed_s", "ex/s",
+        "run_id", "rank", "gen", "steps", "examples", "elapsed_s", "ex/s",
         "rows/s", "p50_ms", "p99_ms", "wait_ms", "loss", "bad_steps",
         "bad_rows", "auc",
     )
@@ -276,49 +294,70 @@ def _newest_run(streams: dict) -> str:
     """run_id whose records carry the largest ts."""
     def run_ts(run_id: str) -> float:
         return max(
-            (r.get("ts", 0.0) for (rid, _, _), recs in streams.items()
+            (r.get("ts", 0.0) for (rid, _, _, _), recs in streams.items()
              if rid == run_id for r in recs if _finite(r.get("ts"))),
             default=0.0,
         )
 
-    run_ids = {rid for rid, _, _ in streams}
+    run_ids = {rid for rid, _, _, _ in streams}
     return max(run_ids, key=run_ts) if run_ids else "?"
 
 
 def bench_record(streams: dict) -> dict:
-    """BENCH-style perf record over the newest run: summed per-rank
-    examples over the longest rank elapsed — the honest cross-rank
-    aggregate (ranks run the same global steps; examples counters are
-    per-rank local rows). Carries the last streaming-eval AUC when the
-    run logged one, so --regress can gate quality too."""
+    """BENCH-style perf record over the newest run: per GENERATION, the
+    summed per-rank examples over the longest rank elapsed (the honest
+    cross-rank aggregate — ranks run the same global steps; examples
+    counters are per-rank local rows); across generations of one
+    supervised run, examples/steps/elapsed SUM (each restart's fit
+    restarts its clock and counters at the resumed stream position).
+    Carries the last streaming-eval AUC when the run logged one, so
+    --regress can gate quality too."""
     if not streams:
         return {}
     newest = _newest_run(streams)
-    rows = {
-        rank: summarize_stream(recs)
-        for (rid, rank), recs in metrics_streams(streams).items()
-        if rid == newest
-    }
-    if not rows:
+    by_gen: dict = {}
+    for (rid, rank, gen), recs in metrics_streams(streams).items():
+        if rid == newest:
+            by_gen.setdefault(gen, {})[rank] = summarize_stream(recs)
+    if not by_gen:
         return {}
-    examples = sum(s["examples"] for s in rows.values())
-    elapsed = max((s["elapsed_s"] for s in rows.values()), default=0.0)
-    steps = max((s["steps"] for s in rows.values()), default=0)
+    examples = sum(s["examples"] for rows in by_gen.values() for s in rows.values())
+    elapsed = sum(
+        max((s["elapsed_s"] for s in rows.values()), default=0.0)
+        for rows in by_gen.values()
+    )
+    steps = sum(
+        max((s["steps"] for s in rows.values()), default=0)
+        for rows in by_gen.values()
+    )
     value = examples / elapsed if elapsed > 0 else 0.0
     rec = {
         "metric": "telemetry_examples_per_sec",
         "value": round(value, 1),
         "unit": "examples/sec",
         "run_id": newest,
-        "ranks": len(rows),
+        "ranks": len({rank for rows in by_gen.values() for rank in rows}),
         "steps": int(steps),
         "examples": int(examples),
         "elapsed_s": round(elapsed, 3),
-        "bad_steps": int(sum(s["bad_steps"] for s in rows.values())),
+        "bad_steps": int(
+            sum(s["bad_steps"] for rows in by_gen.values() for s in rows.values())
+        ),
     }
-    aucs = [s["eval_auc"] for s in rows.values() if _finite(s["eval_auc"])]
-    if aucs:
-        rec["auc"] = round(max(aucs), 6)
+    if len(by_gen) > 1:
+        rec["generations"] = len(by_gen)
+    # quality comes from the NEWEST generation that logged an eval: the
+    # final restart's model is what ships, and a superseded earlier
+    # generation's (possibly better) AUC must not satisfy --regress.
+    # Within one generation max-across-ranks is dedup, not choice — the
+    # eval is collective, every rank logs the same value.
+    for gen in sorted(by_gen, reverse=True):
+        aucs = [
+            s["eval_auc"] for s in by_gen[gen].values() if _finite(s["eval_auc"])
+        ]
+        if aucs:
+            rec["auc"] = round(max(aucs), 6)
+            break
     return rec
 
 
@@ -334,8 +373,11 @@ def heartbeat_rows(streams: dict, run_id: str) -> list[dict]:
     from xflow_tpu.launch.watchdog import classify, fold_heartbeats
 
     beats: dict = {}
-    for (rid, _rank, kind), recs in streams.items():
+    for (rid, _rank, kind, _gen), recs in streams.items():
         if rid == run_id and kind == "heartbeat":
+            # generations fold together: the newest beat per rank wins,
+            # so a rank that died in gen k and finished in gen k+1
+            # correctly reads as finished
             fold_heartbeats(recs, beats)
     if not beats:
         return []
@@ -344,16 +386,27 @@ def heartbeat_rows(streams: dict, run_id: str) -> list[dict]:
 
 
 def render_health(streams: dict) -> str:
-    """The --health view for the newest run."""
+    """The --health view for the newest run, one block per
+    (rank, generation) — a supervised run's restarts segment here."""
     newest = _newest_run(streams)
     lines = [f"health report — run {newest}"]
+    gens = sorted(
+        {gen for (rid, _, gen) in metrics_streams(streams) if rid == newest}
+    )
+    if len(gens) > 1:
+        lines.append(
+            f"  restart generations: {len(gens)} "
+            f"({len(gens) - 1} auto-restart(s); gen {gens[0]}..{gens[-1]})"
+        )
     fmt = lambda v: f"{v:.4g}" if _finite(v) else "-"
-    for (rid, rank), recs in sorted(metrics_streams(streams).items(), key=str):
+    for (rid, rank, gen), recs in sorted(metrics_streams(streams).items(), key=str):
         if rid != newest:
             continue
         s = summarize_stream(recs)
+        gen_tag = f" gen {gen}" if len(gens) > 1 else ""
         lines.append(
-            f"  rank {rank}: steps {s['steps']}  loss {fmt(s['last_loss'])}  "
+            f"  rank {rank}{gen_tag}: steps {s['steps']}  "
+            f"loss {fmt(s['last_loss'])}  "
             f"loss_ema {fmt(s['loss_ema_last'])}"
         )
         lines.append(
@@ -472,12 +525,13 @@ def main(argv=None) -> int:
         print(render_health(streams))
     else:
         rows = []
-        for (run_id, rank), records in sorted(
+        for (run_id, rank, gen), records in sorted(
             metrics_streams(streams).items(), key=str
         ):
             s = summarize_stream(records)
             rows.append((
-                run_id, rank, s["steps"], s["examples"], round(s["elapsed_s"], 1),
+                run_id, rank, gen, s["steps"], s["examples"],
+                round(s["elapsed_s"], 1),
                 s["examples_per_s"], s["rows_per_s"], s["p50_ms"], s["p99_ms"],
                 s["data_wait_ms"], s["last_loss"], s["bad_steps"], s["bad_rows"],
                 s["eval_auc"],

@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Elastic-recovery smoke gate (docs/ROBUSTNESS.md "Elastic recovery"):
+#
+# 1. A clean 50-step supervised launch-local run (telemetry + heartbeats
+#    + checkpoints on) — the steady-state path with the supervision
+#    loop, generation stamping, and data_state writes all active. Gates
+#    on `metrics_report.py --check`, emits the per-PR bench datapoint
+#    (BENCH_r07.json, the docs/PERF.md "Bench trajectory" convention) so
+#    the backoff/stamping machinery is shown to add no steady-state
+#    throughput regression, and self-checks `--regress` against it.
+# 2. The kill-and-recover drill: the same job with an injected SIGKILL
+#    of the rank at step 30 (XFLOW_FAULT_KILL_STEP, on a checkpoint
+#    boundary) under --max-restarts 2. The job must auto-restart without
+#    operator action, restore the committed step-30 checkpoint, resume
+#    the data stream at the stored offset, and finish with the exact
+#    total example count (the final checkpoint's data_state records
+#    cumulative examples across generations — 3200, every row exactly
+#    once). Gates on exit code 0, `--check` accepting the
+#    multi-generation stream, and the data_state accounting.
+#
+# Standalone:    bash tools/smoke_elastic.sh [workdir]
+# From pytest:   tests/test_elastic.py::test_smoke_elastic_script
+#
+# With no workdir argument a temp dir is created and cleaned up.
+set -eu
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# bench datapoint destination: the repo root ONLY standalone (the
+# per-PR record); under pytest it stays in the workdir so test runs
+# never rewrite the committed BENCH_r07.json with machine-local numbers
+BENCH_OUT="$ROOT/BENCH_r07.json"
+if [ -z "$WORK" ]; then
+    WORK="$(mktemp -d)"
+    trap 'rm -rf "$WORK"' EXIT
+else
+    BENCH_OUT="$WORK/BENCH_r07.json"
+fi
+
+export JAX_PLATFORMS=cpu
+
+# 3200 rows / batch 64 = 50 steps in one epoch
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+
+TRAIN_ARGS=(
+    --train "$WORK/train" --model lr --epochs 1
+    --batch-size 64 --log2-slots 12 --no-mesh
+    --set model.num_fields=6
+    --set data.max_nnz=8
+    --set train.pred_dump=false
+    --set train.log_every=10
+    --set train.heartbeat_every=10
+    --set train.checkpoint_every=10
+)
+
+# ---- 1. clean supervised run: steady-state throughput datapoint ------------
+python -m xflow_tpu launch-local --num-processes 1 \
+    --max-restarts 1 --restart-backoff 0.2 \
+    --run-dir "$WORK/run_clean" -- \
+    "${TRAIN_ARGS[@]}" --checkpoint-dir "$WORK/ck_clean" >/dev/null
+
+python tools/metrics_report.py "$WORK/run_clean" --check
+python tools/metrics_report.py "$WORK/run_clean" --bench-json "$BENCH_OUT"
+# regression self-check: a run can never regress against itself
+python tools/metrics_report.py "$WORK/run_clean" --regress "$BENCH_OUT" >/dev/null
+
+# ---- 2. kill-and-recover drill ---------------------------------------------
+# SIGKILL the rank the moment step 30 completes (right after its
+# checkpoint committed); the supervisor must relaunch with resume and
+# the job must still exit 0
+XFLOW_FAULT_KILL_STEP=30 \
+python -m xflow_tpu launch-local --num-processes 1 \
+    --max-restarts 2 --restart-backoff 0.2 \
+    --run-dir "$WORK/run_kill" -- \
+    "${TRAIN_ARGS[@]}" --checkpoint-dir "$WORK/ck_kill" >/dev/null
+
+# the multi-generation stream passes the schema gate
+python tools/metrics_report.py "$WORK/run_kill" --check
+python tools/metrics_report.py "$WORK/run_kill" --health >/dev/null
+
+# exact accounting: the final checkpoint is step 50 with a completed
+# data_state whose cumulative example count covers every row exactly
+# once (no replay: the kill landed on the committed step-30 boundary),
+# and the metrics streams really span two generations
+python - "$WORK" <<'EOF'
+import json, os, sys
+from xflow_tpu.jsonl import read_jsonl
+from xflow_tpu.train.checkpoint import latest_step, read_data_state
+
+work = sys.argv[1]
+step = latest_step(os.path.join(work, "ck_kill"))
+assert step == 50, f"final committed step {step} != 50"
+ds = read_data_state(os.path.join(work, "ck_kill"), step)
+assert ds and ds["completed"], f"data_state not completed: {ds}"
+assert ds["examples"] == 3200, f"examples {ds['examples']} != 3200 (replay or loss)"
+recs = read_jsonl(os.path.join(work, "run_kill", "metrics_rank0.jsonl"))
+gens = {r.get("gen", 0) for r in recs}
+assert gens == {0, 1}, f"expected generations {{0, 1}}, got {gens}"
+print("smoke_elastic: kill drill accounting OK "
+      f"(step {step}, examples {ds['examples']}, generations {sorted(gens)})")
+EOF
+echo "smoke_elastic: OK"

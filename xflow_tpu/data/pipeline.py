@@ -138,12 +138,30 @@ def examples_to_batches(
         yield make_batch(fields, slots, labels, batch_size, max_nnz)
 
 
+def skip_batches(
+    batches: Iterator[SparseBatch], n: int
+) -> Iterator[SparseBatch]:
+    """Fast-skip the first `n` batches of a stream — the exact-resume
+    seam (docs/ROBUSTNESS.md "Elastic recovery"): a resumed run
+    re-parses the already-trained prefix (parsing is the cheap part)
+    but the skipped batches bypass EVERYTHING downstream — the
+    bad-record monitor (no duplicate quarantine records, no double
+    budget counting), sorted-plan building, health bitmaps, and the
+    device transfer — so the stream continues at the stored offset
+    instead of replaying it. Placed UNDER monitor_bad_rows on purpose;
+    the generator form keeps prefetch's close() cascade intact."""
+    for i, batch in enumerate(batches):
+        if i >= n:
+            yield batch
+
+
 def batch_iterator(
     path: str,
     cfg: DataConfig,
     batch_size: Optional[int] = None,
     enforce_bad_rows: bool = True,
     quarantine: bool = True,
+    skip: int = 0,
 ) -> Iterator[SparseBatch]:
     """Stream padded batches from a libffm file, preferring the native
     parser. Every batch passes through the bad-record monitor
@@ -151,9 +169,15 @@ def batch_iterator(
     identically for both parser paths, and exceeding data.max_bad_rows
     raises before an epoch of garbage trains in (eval passes set
     `enforce_bad_rows=False`: count and warn, never kill a finished
-    model's predict pass)."""
+    model's predict pass). `skip` fast-forwards the stream past its
+    first `skip` batches (checkpointed data_state resume,
+    `skip_batches`) — skipped batches are neither monitored nor
+    quarantined; they were already, in the run being resumed."""
+    raw = _raw_batch_iterator(path, cfg, batch_size)
+    if skip > 0:
+        raw = skip_batches(raw, skip)
     yield from monitor_bad_rows(
-        _raw_batch_iterator(path, cfg, batch_size), cfg, path,
+        raw, cfg, path,
         enforce=enforce_bad_rows, quarantine=quarantine,
     )
 

@@ -41,6 +41,15 @@ class JsonlAppender:
             from xflow_tpu.telemetry import resolve_rank, resolve_run_id
 
             self._static = {"rank": resolve_rank(), "run_id": resolve_run_id()}
+        if "gen" not in self._static:
+            # restart generation (elastic recovery, docs/OBSERVABILITY.md
+            # "Restart generations"): resolved lazily like rank/run_id so
+            # callers that pass an explicit stamp still get it, and a
+            # supervisor-exported XFLOW_RESTART_GEN has settled by the
+            # first append
+            from xflow_tpu.telemetry import resolve_restart_gen
+
+            self._static = {**self._static, "gen": resolve_restart_gen()}
         return self._static
 
     def append(self, record: dict) -> None:

@@ -29,6 +29,24 @@ def _add_common(ap: argparse.ArgumentParser) -> None:
                     help="dotted config override, e.g. --set optim.name=sgd")
 
 
+def _add_supervise_flags(ap: argparse.ArgumentParser) -> None:
+    """Supervised auto-restart knobs shared by launch-local/launch-dist
+    (launch/supervise.py). --max-restarts 0 (default) keeps the plain
+    single-attempt behavior."""
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="relaunch the whole job (with train.resume=true) up "
+                         "to this many times after a nonzero rank exit or a "
+                         "watchdog dead-rank verdict (default 0 = no "
+                         "supervision)")
+    ap.add_argument("--restart-backoff", type=float, default=1.0,
+                    help="base seconds between restarts; doubles per attempt "
+                         "with jitter, capped at 60s (default 1.0)")
+    ap.add_argument("--min-uptime-s", type=float, default=0.0,
+                    help="an attempt dying faster than this is treated as a "
+                         "config error and NOT restarted (default 0 = always "
+                         "restart while the budget lasts)")
+
+
 def _add_watchdog_flags(ap: argparse.ArgumentParser) -> None:
     """Liveness-watchdog knobs shared by launch-local/launch-dist
     (active with --run-dir; launch/watchdog.py). 0 = module default."""
@@ -190,6 +208,8 @@ def cmd_launch_local(args) -> int:
         args.num_processes, args.forward, port=args.port, run_dir=args.run_dir,
         straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
         watchdog_poll_s=args.watchdog_poll_s,
+        max_restarts=args.max_restarts, restart_backoff=args.restart_backoff,
+        min_uptime_s=args.min_uptime_s,
     )
 
 
@@ -214,6 +234,8 @@ def cmd_launch_dist(args) -> int:
         dry_run=args.dry_run, run_dir=args.run_dir,
         straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
         watchdog_poll_s=args.watchdog_poll_s,
+        max_restarts=args.max_restarts, restart_backoff=args.restart_backoff,
+        min_uptime_s=args.min_uptime_s,
     )
 
 
@@ -298,6 +320,7 @@ def main(argv=None) -> int:
                          "ranks share one run_id; summarize with "
                          "tools/metrics_report.py")
     _add_watchdog_flags(ll)
+    _add_supervise_flags(ll)
     ll.add_argument("forward", nargs=argparse.REMAINDER,
                     help="-- followed by `xflow train` args to run in every process")
     ll.set_defaults(fn=cmd_launch_local)
@@ -328,6 +351,7 @@ def main(argv=None) -> int:
     ld.add_argument("--dry-run", action="store_true",
                     help="print the per-host command lines instead of running")
     _add_watchdog_flags(ld)
+    _add_supervise_flags(ld)
     ld.add_argument("forward", nargs=argparse.REMAINDER,
                     help="-- followed by `xflow train` args to run on every host")
     ld.set_defaults(fn=cmd_launch_dist)

@@ -135,30 +135,90 @@ def launch_dist(
     straggler_factor: float = 0.0,
     dead_after_s: float = 0.0,
     watchdog_poll_s: float = 0.0,
+    max_restarts: int = 0,
+    restart_backoff: float = 1.0,
+    min_uptime_s: float = 0.0,
 ) -> int:
-    """Start one rank per host over ssh and wait for all of them.
+    """Start one rank per host over ssh, under the supervision loop.
+
+    One attempt (`_launch_dist_once`) starts every rank and fail-fasts
+    on the first nonzero exit or watchdog dead-rank verdict. With
+    ``--max-restarts`` the supervision wrapper (launch/supervise.py)
+    then relaunches the WHOLE job — same hosts, same run id and run
+    dir, ``train.resume=true`` forced, the restart generation exported
+    as XFLOW_RESTART_GEN to every rank — with exponential backoff +
+    jitter between attempts. Transient ssh/connect failures (a host
+    rebooting out of a preemption, a TIME_WAIT coordinator port) ride
+    the same loop: the failed attempt tears down, the backoff absorbs
+    the blip, the relaunch reconnects; the rendezvous itself also
+    retries per rank (parallel/distributed.py). max_restarts=0 is one
+    plain un-supervised attempt."""
+    from xflow_tpu.launch.local import resolve_launch_run_id
+    from xflow_tpu.launch.supervise import resume_forward_args, supervise
+
+    if forward_args and forward_args[0] == "--":
+        forward_args = forward_args[1:]
+    # one run id across all ranks AND all restart generations, ALWAYS
+    # (not just under --run-dir: ranks given a metrics_path via
+    # forwarded --set args must join too)
+    env_extra = dict(env_extra or {})
+    env_extra.setdefault("XFLOW_RUN_ID", resolve_launch_run_id())
+    if dry_run:
+        return _launch_dist_once(
+            hosts, forward_args, port=port, ssh_cmd=ssh_cmd, workdir=workdir,
+            python=python, env_extra=env_extra, dry_run=True, run_dir=run_dir,
+        )
+
+    def attempt(gen: int) -> int:
+        args = forward_args if gen == 0 else resume_forward_args(forward_args)
+        env_gen = {**env_extra, "XFLOW_RESTART_GEN": str(gen)}
+        return _launch_dist_once(
+            hosts, args, port=port, ssh_cmd=ssh_cmd, workdir=workdir,
+            python=python, env_extra=env_gen, run_dir=run_dir,
+            straggler_factor=straggler_factor, dead_after_s=dead_after_s,
+            watchdog_poll_s=watchdog_poll_s, gen=gen,
+        )
+
+    return supervise(
+        attempt,
+        max_restarts=max_restarts,
+        restart_backoff=restart_backoff,
+        min_uptime_s=min_uptime_s,
+        label="launch-dist",
+    )
+
+
+def _launch_dist_once(
+    hosts: list[str],
+    forward_args: list[str],
+    port: int = 29431,
+    ssh_cmd: str = "ssh",
+    workdir: str = "",
+    python: str = "",
+    env_extra: dict | None = None,
+    dry_run: bool = False,
+    run_dir: str = "",
+    straggler_factor: float = 0.0,
+    dead_after_s: float = 0.0,
+    watchdog_poll_s: float = 0.0,
+    gen: int = 0,
+) -> int:
+    """One attempt: start one rank per host over ssh and wait for all.
 
     Output streams are inherited (prefix-free, like the reference's
     `start_worker.sh` background jobs). FAIL-FAST: SPMD ranks block in
-    collectives when a peer dies, so the first rank to exit NONZERO
-    terminates the rest (after `grace_s` seconds for the stragglers'
+    collectives when a peer dies, so the first rank to exit NONZERO —
+    or a watchdog dead/missing verdict (a wedged host that never exits)
+    — terminates the rest (after `grace_s` seconds for the stragglers'
     own error output) and its exit code is returned. Rank 0 (the first
     host) is started LAST so the coordinator's listener never races the
     workers' connect loop backwards — JAX ranks retry the rendezvous,
     so ordering is cosmetic, but starting workers first keeps slow-host
     stragglers off the critical path.
     """
-    import time
-
-    if forward_args and forward_args[0] == "--":
-        forward_args = forward_args[1:]
-    # one run id across all ranks, ALWAYS (not just under --run-dir:
-    # ranks given a metrics_path via forwarded --set args must join
-    # too) — the per-rank JSONL streams group on it
-    from xflow_tpu.launch.local import resolve_launch_run_id
+    import threading
 
     env_extra = dict(env_extra or {})
-    env_extra.setdefault("XFLOW_RUN_ID", resolve_launch_run_id())
     cmds = [
         rank_command(h, i, hosts, forward_args, port, workdir, python, env_extra,
                      run_dir=run_dir)
@@ -170,6 +230,7 @@ def launch_dist(
             print(f"{ssh_cmd} {h} {shlex.quote(c)}")
         return 0
     watchdog = None
+    dead_verdict = threading.Event()
     if run_dir:
         # mirror launch_local: create the run dir from this seat so the
         # recommended shared-filesystem setup works without
@@ -200,7 +261,13 @@ def launch_dist(
             straggler_factor=straggler_factor,
             dead_after_s=dead_after_s,
             poll_s=watchdog_poll_s,
-            run_id=env_extra["XFLOW_RUN_ID"],
+            run_id=env_extra.get("XFLOW_RUN_ID", ""),
+            # escalation policy (elastic recovery): the verdict only
+            # SETS a flag here; teardown happens on the launcher
+            # thread's poll loop below, and the supervision wrapper
+            # decides whether the job relaunches
+            on_dead=lambda row: dead_verdict.set(),
+            gen=gen,
         )
         watchdog.start()
     procs = []
@@ -209,23 +276,17 @@ def launch_dist(
     def teardown(procs):
         """Close stdin pipes first (the remote die-with-connection
         watcher fires on EOF — the graceful path even over dead ssh
-        clients), then TERM the local clients, then KILL stragglers:
-        ssh ignoring TERM must not leave the launcher hanging."""
+        clients), then the shared TERM-then-KILL escalation: ssh
+        ignoring TERM must not leave the launcher hanging."""
+        from xflow_tpu.launch.supervise import terminate_procs
+
         for p in procs:
             if p.stdin:
                 try:
                     p.stdin.close()
                 except OSError:
                     pass
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        deadline = time.time() + 5.0
-        while time.time() < deadline and any(p.poll() is None for p in procs):
-            time.sleep(0.2)
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+        terminate_procs(procs)
 
     try:
         for i in reversed(range(len(hosts))):
@@ -237,27 +298,12 @@ def launch_dist(
                     stdin=subprocess.PIPE,
                 )
             )
-        first_bad = 0
-        while True:
-            codes = [p.poll() for p in procs]
-            bad = [c for c in codes if c]  # nonzero AND not None
-            if bad and not first_bad:
-                first_bad = bad[0]
-                print(
-                    f"launch-dist: a rank exited with code {first_bad}; "
-                    f"terminating the remaining ranks in {grace_s:.0f}s "
-                    "(peers would otherwise block in collectives forever)",
-                    file=sys.stderr,
-                )
-                deadline = time.time() + grace_s
-                while time.time() < deadline and any(
-                    p.poll() is None for p in procs
-                ):
-                    time.sleep(0.5)
-                teardown(procs)
-            if all(c is not None for c in codes):
-                return first_bad or next((c for c in codes if c), 0)
-            time.sleep(0.5)
+        from xflow_tpu.launch.supervise import wait_fail_fast
+
+        return wait_fail_fast(
+            procs, teardown, dead_verdict=dead_verdict, label="launch-dist",
+            grace_s=grace_s, poll_s=0.5,
+        )
     except KeyboardInterrupt:
         teardown(procs)
         for p in procs:

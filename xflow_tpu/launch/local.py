@@ -15,6 +15,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 
 
 def _free_port() -> int:
@@ -53,7 +54,15 @@ def resolve_launch_run_id() -> str:
     return new_run_id()
 
 
-def launch_local(
+def _teardown(procs) -> None:
+    """TERM-then-KILL every live rank (the shared escalation,
+    launch/supervise.terminate_procs)."""
+    from xflow_tpu.launch.supervise import terminate_procs
+
+    terminate_procs(procs)
+
+
+def _launch_local_once(
     num_processes: int,
     forward_args: list[str],
     port: int = 0,
@@ -61,20 +70,26 @@ def launch_local(
     straggler_factor: float = 0.0,
     dead_after_s: float = 0.0,
     watchdog_poll_s: float = 0.0,
+    run_id: str = "",
+    gen: int = 0,
 ) -> int:
-    if forward_args and forward_args[0] == "--":
-        forward_args = forward_args[1:]
+    """One attempt: fork the ranks, watch them, return the job's exit
+    code. FAIL-FAST like launch-dist: SPMD peers of a dead rank block
+    in collectives forever, so the first nonzero rank exit — or a
+    watchdog dead/missing verdict (a WEDGED rank, which never exits on
+    its own) — tears the whole job down; the supervision wrapper
+    (`launch_local`) decides whether to relaunch."""
     port = port or _free_port()
     coordinator = f"127.0.0.1:{port}"
-    # one run id across all ranks: their metrics/quarantine JSONL
-    # streams join on it (telemetry.resolve_run_id reads the env)
-    run_id = resolve_launch_run_id()
     watchdog = None
+    dead_verdict = threading.Event()
     if run_dir:
         os.makedirs(run_dir, exist_ok=True)
         # liveness watchdog over the ranks' heartbeat streams: flags
         # dead ranks and stragglers while the run is still going
-        # (launch/watchdog.py; <= 0 knobs take the module defaults)
+        # (launch/watchdog.py; <= 0 knobs take the module defaults).
+        # The on_dead policy only SETS a flag — teardown happens on the
+        # launcher thread below, never on the poller thread.
         from xflow_tpu.launch.watchdog import RunWatchdog
 
         watchdog = RunWatchdog(
@@ -84,6 +99,8 @@ def launch_local(
             dead_after_s=dead_after_s,
             poll_s=watchdog_poll_s,
             run_id=run_id,
+            on_dead=lambda row: dead_verdict.set(),
+            gen=gen,
         )
         watchdog.start()
     procs = []
@@ -94,6 +111,10 @@ def launch_local(
             XFLOW_NUM_PROCESSES=str(num_processes),
             XFLOW_PROCESS_ID=str(rank),
             XFLOW_RUN_ID=run_id,
+            # restart generation: stamped into every JSONL record the
+            # rank emits (jsonl.JsonlAppender) so metrics_report.py can
+            # segment the multi-generation streams of a supervised run
+            XFLOW_RESTART_GEN=str(gen),
             # Children MUST default to CPU: inheriting an ambient
             # accelerator platform would land every child on the same
             # device (this image pins one TPU), the world would never
@@ -108,11 +129,66 @@ def launch_local(
             *forward_args, *rank_metrics_args(run_dir, rank),
         ]
         procs.append(subprocess.Popen(cmd, env=env))
-    rc = 0
+    from xflow_tpu.launch.supervise import wait_fail_fast
+
     try:
-        for p in procs:
-            rc = p.wait() or rc
+        return wait_fail_fast(
+            procs, _teardown, dead_verdict=dead_verdict, label="launch-local"
+        )
+    except KeyboardInterrupt:
+        _teardown(procs)
+        raise
     finally:
         if watchdog is not None:
             watchdog.stop()
-    return rc
+
+
+def launch_local(
+    num_processes: int,
+    forward_args: list[str],
+    port: int = 0,
+    run_dir: str = "",
+    straggler_factor: float = 0.0,
+    dead_after_s: float = 0.0,
+    watchdog_poll_s: float = 0.0,
+    max_restarts: int = 0,
+    restart_backoff: float = 1.0,
+    min_uptime_s: float = 0.0,
+) -> int:
+    """Run the local cluster under the supervision loop
+    (launch/supervise.py): on a nonzero rank exit or a watchdog
+    dead-rank verdict the whole job is torn down and — while the
+    ``--max-restarts`` budget lasts — relaunched with
+    ``train.resume=true`` under the SAME run dir and run id, the
+    restart generation stamped into every record. max_restarts=0 is
+    one plain un-supervised attempt."""
+    from xflow_tpu.launch.supervise import resume_forward_args, supervise
+
+    if forward_args and forward_args[0] == "--":
+        forward_args = forward_args[1:]
+    # one run id across all ranks AND all restart generations: their
+    # metrics/quarantine/heartbeat JSONL streams join on it, and the
+    # `gen` stamp keeps the generations apart within it
+    run_id = resolve_launch_run_id()
+
+    def attempt(gen: int) -> int:
+        args = forward_args if gen == 0 else resume_forward_args(forward_args)
+        return _launch_local_once(
+            num_processes,
+            args,
+            port=port,
+            run_dir=run_dir,
+            straggler_factor=straggler_factor,
+            dead_after_s=dead_after_s,
+            watchdog_poll_s=watchdog_poll_s,
+            run_id=run_id,
+            gen=gen,
+        )
+
+    return supervise(
+        attempt,
+        max_restarts=max_restarts,
+        restart_backoff=restart_backoff,
+        min_uptime_s=min_uptime_s,
+        label="launch-local",
+    )

@@ -1,0 +1,233 @@
+"""Supervised auto-restart: the recovery half of the fault-tolerance
+contract (docs/ROBUSTNESS.md "Elastic recovery").
+
+PR 1/PR 3 built detection — non-finite guards, self-healing restores,
+the heartbeat/straggler watchdog — but a preempted or killed rank still
+ended the run until a human relaunched it, which is exactly the gap
+classic parameter-server systems close with supervised restarts (Li et
+al., OSDI'14: recovery, not just detection, is the contract). This
+module is the ONE supervision loop both launchers wrap their job in:
+
+- `supervise(run_attempt, ...)` re-runs the whole job (all ranks torn
+  down and relaunched together — SPMD peers of a dead rank are blocked
+  in collectives and unrecoverable in place) with exponential backoff
+  and jitter between attempts, up to ``--max-restarts`` times.
+- Every relaunch forces ``train.resume=true`` (`resume_forward_args`),
+  so the job restores the last COMMITTED checkpoint and — with the
+  checkpoint's `data_state` — continues the input stream at the stored
+  offset instead of replaying it (train/checkpoint.py).
+- The attempt index is the **restart generation**, exported to every
+  rank as ``XFLOW_RESTART_GEN`` and stamped as `gen` into every JSONL
+  record (jsonl.JsonlAppender), so `metrics_report.py` segments the
+  multi-generation streams instead of tripping on step counters that
+  restart from 0.
+- ``--min-uptime-s``: an attempt that dies FASTER than this is treated
+  as a configuration error (a crash loop would burn every restart in
+  seconds), not a transient fault — supervision stops and the exit
+  code surfaces.
+
+`backoff_delay` / `retry_call` are the shared transient-failure
+primitives; `parallel/distributed.py` reuses them for rendezvous
+retries (a restarted rank rejoining before its peers must not turn a
+survivable blip into a failed job).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+from typing import Callable, Optional
+
+BACKOFF_CAP_S = 60.0
+# returned when only the watchdog's dead/missing verdict failed the
+# attempt (a wedged rank never exits, so there is no child code to
+# propagate): EX_TEMPFAIL — "temporary failure, retry" is exactly what
+# the supervision loop should read
+EX_TEMPFAIL = 75
+
+
+def backoff_delay(
+    attempt: int, base_s: float, cap_s: float = BACKOFF_CAP_S, rng=None
+) -> float:
+    """Exponential backoff with jitter: base·2^attempt capped at
+    `cap_s`, then scaled uniformly into [0.5, 1.0]× — the decorrelation
+    that keeps N restarted ranks (or N supervised jobs sharing a
+    coordinator) from re-stampeding the rendezvous in lockstep."""
+    d = min(float(base_s) * (2.0 ** max(int(attempt), 0)), float(cap_s))
+    return d * (rng or random).uniform(0.5, 1.0)
+
+
+def retry_call(
+    fn: Callable,
+    what: str,
+    retries: int,
+    base_s: float,
+    cap_s: float = BACKOFF_CAP_S,
+    cleanup: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    out=None,
+):
+    """Call `fn()` with up to `retries` backoff-spaced retries.
+
+    Every failure is logged with its reason and the chosen delay;
+    `cleanup` (when given) runs between attempts to tear down partial
+    state the failed call may have left (e.g. a half-initialized
+    distributed runtime). The LAST failure propagates unchanged."""
+    for attempt in range(max(int(retries), 0) + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — transient-failure seam:
+            # every failure mode retries; the last one propagates as-is
+            if attempt >= retries:
+                raise
+            delay = backoff_delay(attempt, base_s, cap_s)
+            print(
+                f"{what}: attempt {attempt + 1}/{retries + 1} failed "
+                f"({type(e).__name__}: {e}); retrying in {delay:.1f}s",
+                file=out or sys.stderr,
+            )
+            if cleanup is not None:
+                try:
+                    cleanup()
+                except Exception:
+                    pass
+            sleep(delay)
+
+
+def terminate_procs(procs, kill_after_s: float = 5.0) -> None:
+    """TERM every live process, then KILL stragglers after
+    `kill_after_s` — the ONE escalation both launchers' teardowns end
+    with (a rank blocked in a collective never reaches a
+    signal-coordination point, so the KILL is mandatory; launch-dist
+    additionally closes ssh stdin pipes first, its die-with-connection
+    signal)."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + kill_after_s
+    while time.monotonic() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.2)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+
+
+def wait_fail_fast(
+    procs,
+    teardown: Callable,
+    dead_verdict=None,
+    label: str = "launch",
+    grace_s: float = 0.0,
+    poll_s: float = 0.2,
+    out=None,
+) -> int:
+    """Poll rank processes until all exit; FAIL-FAST on the first bad
+    sign. The ONE wait loop both launchers run (launch/local.py,
+    launch/dist.py — only their teardown mechanics differ): on the
+    first NONZERO rank exit, or a watchdog dead/missing verdict
+    (`dead_verdict`, a threading.Event set by the RunWatchdog's on_dead
+    policy — a wedged rank never exits on its own), wait `grace_s` for
+    stragglers' own error output, then `teardown(procs)` — SPMD peers
+    of a dead rank are blocked in collectives and unrecoverable in
+    place. Returns the first bad rank's exit code (EX_TEMPFAIL for a
+    verdict-only failure), or 0 when every rank exits clean."""
+    first_bad = 0
+    while True:
+        codes = [p.poll() for p in procs]
+        bad = [c for c in codes if c]  # nonzero AND not None
+        if not first_bad and (
+            bad or (dead_verdict is not None and dead_verdict.is_set())
+        ):
+            first_bad = bad[0] if bad else EX_TEMPFAIL
+            reason = (
+                f"a rank exited with code {first_bad}"
+                if bad
+                else "watchdog verdict: dead/missing rank"
+            )
+            grace_note = f" in {grace_s:.0f}s" if grace_s > 0 else ""
+            print(
+                f"{label}: {reason}; terminating the remaining ranks"
+                f"{grace_note} (peers would otherwise block in collectives "
+                "forever)",
+                file=out or sys.stderr,
+            )
+            if grace_s > 0:
+                deadline = time.monotonic() + grace_s
+                while time.monotonic() < deadline and any(
+                    p.poll() is None for p in procs
+                ):
+                    time.sleep(poll_s)
+            teardown(procs)
+        if all(c is not None for c in codes):
+            return first_bad or next((c for c in codes if c), 0)
+        time.sleep(poll_s)
+
+
+def resume_forward_args(forward_args: list[str]) -> list[str]:
+    """The relaunch's `xflow train` argv: the original args plus a
+    FORCED train.resume=true appended last, so it wins over any
+    user-passed `--set train.resume=false` (cli._build_config applies
+    --set pairs in order) and the restarted job restores the last
+    committed checkpoint + data_state instead of training from
+    scratch."""
+    return [*forward_args, "--set", "train.resume=true"]
+
+
+def supervise(
+    run_attempt: Callable[[int], int],
+    max_restarts: int = 0,
+    restart_backoff: float = 1.0,
+    min_uptime_s: float = 0.0,
+    label: str = "launch",
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    out=None,
+) -> int:
+    """Run `run_attempt(gen)` until it exits 0 or the restart budget is
+    spent; returns the final attempt's exit code.
+
+    `run_attempt` receives the restart generation (0 = first launch)
+    and owns the actual job: spawning every rank with
+    ``XFLOW_RESTART_GEN=<gen>``, tearing all ranks down on a failure
+    (a nonzero exit or a watchdog dead-rank verdict), and returning the
+    job's exit code. Generations > 0 must launch with
+    `resume_forward_args`. max_restarts=0 is plain un-supervised
+    behavior: one attempt, its code returned."""
+    err = out or sys.stderr
+    gen = 0
+    while True:
+        t0 = clock()
+        rc = int(run_attempt(gen))
+        uptime = clock() - t0
+        if rc == 0:
+            if gen:
+                print(
+                    f"{label}: job succeeded after {gen} restart(s)", file=err
+                )
+            return 0
+        if gen >= max_restarts:
+            if max_restarts > 0:
+                print(
+                    f"{label}: restart budget exhausted "
+                    f"({max_restarts} restart(s)); giving up with rc={rc}",
+                    file=err,
+                )
+            return rc
+        if min_uptime_s > 0 and uptime < min_uptime_s:
+            print(
+                f"{label}: attempt {gen} died after {uptime:.1f}s "
+                f"(< --min-uptime-s {min_uptime_s:g}) — this looks like a "
+                "configuration error, not a transient fault; not restarting",
+                file=err,
+            )
+            return rc
+        delay = backoff_delay(gen, restart_backoff)
+        print(
+            f"{label}: attempt {gen} exited rc={rc} after {uptime:.1f}s; "
+            f"restarting generation {gen + 1} with train.resume=true in "
+            f"{delay:.1f}s ({max_restarts - gen} restart(s) left)",
+            file=err,
+        )
+        sleep(delay)
+        gen += 1

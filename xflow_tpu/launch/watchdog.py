@@ -44,7 +44,10 @@ DEFAULT_POLL_S = 2.0
 
 
 def fold_heartbeats(
-    records, beats: Optional[dict] = None, run_id: Optional[str] = None
+    records,
+    beats: Optional[dict] = None,
+    run_id: Optional[str] = None,
+    gen: Optional[int] = None,
 ) -> dict:
     """Fold heartbeat records into {rank: {"step", "ts", "event"}},
     keeping the newest record per rank (a step-less event keeps the
@@ -54,13 +57,30 @@ def fold_heartbeats(
     they cannot drift. `run_id` filters to one launch — a reused
     --run-dir appends a second run's beats to the same files, and
     without the filter the OLD run's ranks would read as permanently
-    dead in the new run's live view."""
+    dead in the new run's live view. `gen` filters to one RESTART
+    GENERATION the same way: a supervised relaunch keeps the run_id,
+    and without the filter the previous attempt's stale (or already
+    dead-verdicted) beats would re-fire the new watchdog's dead policy
+    before the relaunched ranks write their first beat — a teardown
+    loop that burns the whole restart budget. The offline view passes
+    neither: it folds everything, newest beat per rank winning."""
     beats = {} if beats is None else beats
     for rec in records:
         rank = rec.get("rank")
         ts = rec.get("ts")
         if run_id is not None and rec.get("run_id") != run_id:
             continue
+        if gen is not None:
+            # defensive like rank/ts below: one damaged gen value (a
+            # string, a NaN) must skip one record, not raise and blind
+            # every later watchdog scan
+            g = rec.get("gen", 0)
+            try:
+                g = int(g) if isinstance(g, (int, float)) else None
+            except (ValueError, OverflowError):  # NaN/inf floats
+                g = None
+            if g != gen:
+                continue
         if not isinstance(rank, int) or not isinstance(ts, (int, float)):
             continue
         cur = beats.get(rank)
@@ -74,17 +94,20 @@ def fold_heartbeats(
     return beats
 
 
-def read_heartbeats(run_dir: str, run_id: Optional[str] = None) -> dict:
+def read_heartbeats(
+    run_dir: str, run_id: Optional[str] = None, gen: Optional[int] = None
+) -> dict:
     """{rank: {"step": int, "ts": float, "event": str|None}} — the
     newest heartbeat per rank across ``heartbeat_rank*.jsonl`` in
-    `run_dir`, optionally restricted to one `run_id` (see
-    `fold_heartbeats`). Truncation-tolerant (a rank killed mid-append
-    must not blind the watchdog to its earlier beats)."""
+    `run_dir`, optionally restricted to one `run_id` and one restart
+    generation (see `fold_heartbeats`). Truncation-tolerant (a rank
+    killed mid-append must not blind the watchdog to its earlier
+    beats)."""
     from xflow_tpu.jsonl import read_jsonl
 
     beats: dict = {}
     for path in sorted(glob.glob(os.path.join(run_dir, "heartbeat_rank*.jsonl"))):
-        fold_heartbeats(read_jsonl(path, warn=False), beats, run_id=run_id)
+        fold_heartbeats(read_jsonl(path, warn=False), beats, run_id=run_id, gen=gen)
     return beats
 
 
@@ -157,9 +180,20 @@ class RunWatchdog:
     """Launcher-side poller: warn on stderr (and append events to
     ``<run_dir>/watchdog.jsonl``) whenever a rank's status degrades to
     straggler/dead, and log the recovery when it comes back. Started by
-    ``launch-local``/``launch-dist`` when ``--run-dir`` is set; purely
-    observational — teardown policy stays with the launcher (launch-dist
-    already fail-fasts on a nonzero rank exit)."""
+    ``launch-local``/``launch-dist`` when ``--run-dir`` is set.
+
+    Escalation is a PLUGGABLE policy, not built in: by default the
+    watchdog only flags (teardown stays with the launcher, which
+    already fail-fasts on a nonzero rank exit), but `on_dead` — called
+    once per rank transition into ``dead``/``missing``, with the status
+    row — lets a caller act on the verdict. The supervised launchers
+    (launch/local.py, launch/dist.py under ``--max-restarts``) pass a
+    policy that tears the whole job down and relaunches it with
+    ``train.resume=true`` (launch/supervise.py): a WEDGED rank (alive
+    but stuck — the case a nonzero exit never signals) is thereby
+    recovered instead of merely reported. `gen` stamps the restart
+    generation into watchdog.jsonl events (the launcher process owns
+    the generation; its own env has no XFLOW_RESTART_GEN)."""
 
     def __init__(
         self,
@@ -170,10 +204,13 @@ class RunWatchdog:
         poll_s: float = 0.0,
         run_id: str = "",
         out=None,
+        on_dead=None,
+        gen: int = 0,
     ):
         from xflow_tpu.jsonl import JsonlAppender
 
         self._run_dir = run_dir
+        self._on_dead = on_dead
         self._n = num_ranks
         # <= 0 means "module default" — the launchers and their CLI
         # flags pass 0 straight through, so the sentinel resolution
@@ -183,10 +220,17 @@ class RunWatchdog:
         self._poll = max(float(poll_s), 0.05) if poll_s > 0 else DEFAULT_POLL_S
         self._out = out  # test seam; defaults to sys.stderr
         self._run_id = run_id
+        self._gen = int(gen)
         self._events = JsonlAppender(
             os.path.join(run_dir, "watchdog.jsonl"),
-            # rank -1 = the launcher itself; kind separates the stream
-            stamp={"rank": -1, "run_id": run_id or "?", "kind": "watchdog"},
+            # rank -1 = the launcher itself; kind separates the stream;
+            # gen passed explicitly (see class docstring)
+            stamp={
+                "rank": -1,
+                "run_id": run_id or "?",
+                "kind": "watchdog",
+                "gen": int(gen),
+            },
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -203,7 +247,11 @@ class RunWatchdog:
     def poll_once(self, now: Optional[float] = None) -> list[dict]:
         """One scan (also the test seam): classify every rank and report
         transitions."""
-        beats = read_heartbeats(self._run_dir, run_id=self._run_id or None)
+        # generation-filtered: a relaunched attempt must not classify
+        # (and re-kill) on the PREVIOUS attempt's stale beats
+        beats = read_heartbeats(
+            self._run_dir, run_id=self._run_id or None, gen=self._gen
+        )
         t = time.time() if now is None else now
         # "missing" needs a startup grace: ranks open their heartbeat
         # streams hundreds of ms apart, and a poll landing between the
@@ -247,6 +295,17 @@ class RunWatchdog:
                     f" (step {row['step']} vs leader {row['max_step']}, {beat})",
                     file=self._out or sys.stderr,
                 )
+                if status in ("dead", "missing") and self._on_dead is not None:
+                    # escalation policy: once per transition, AFTER the
+                    # event is durably logged; a policy error must not
+                    # kill the poller (the flagging half keeps working)
+                    try:
+                        self._on_dead(dict(row))
+                    except Exception as e:
+                        print(
+                            f"launch watchdog: on_dead policy failed: {e}",
+                            file=self._out or sys.stderr,
+                        )
             elif status in ("ok", "finished") and prev in ("straggler", "dead", "missing"):
                 self._events.append({"event": "recovered", **payload})
                 print(

@@ -14,6 +14,22 @@ close to the reference's so migration is mechanical):
   ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``)
 - ``XFLOW_NUM_PROCESSES`` — world size (reference: ``DMLC_NUM_WORKER``)
 - ``XFLOW_PROCESS_ID`` — this rank
+
+Rendezvous hardening (elastic recovery, docs/ROBUSTNESS.md): a
+supervised auto-restart (launch/supervise.py) relaunches every rank of
+a job, and a restarted rank reaching the rendezvous BEFORE rank 0's
+coordinator is listening would fail the whole attempt on what is only
+a startup race. `jax.distributed.initialize` is therefore wrapped in
+bounded retry with exponential backoff + jitter:
+
+- ``XFLOW_RENDEZVOUS_RETRIES`` (default 3) — retries after the first
+  failure; 0 restores the old fail-on-first-error behavior,
+- ``XFLOW_RENDEZVOUS_BACKOFF_S`` (default 1.0) — backoff base; the
+  delay doubles per attempt (capped at 30 s) with [0.5, 1.0]× jitter
+  so N restarted ranks don't re-stampede the coordinator in lockstep.
+
+Between attempts the half-initialized runtime is shut down
+(`jax.distributed.shutdown`), so a retry starts from a clean slate.
 """
 
 from __future__ import annotations
@@ -22,6 +38,41 @@ import os
 from typing import Optional
 
 import jax
+
+
+def _rendezvous_retry_env() -> tuple[int, float]:
+    """(retries, backoff_base_s) from the env, defensively parsed — a
+    junk value must degrade to the default, not kill the launch."""
+    try:
+        retries = int(os.environ.get("XFLOW_RENDEZVOUS_RETRIES", "3") or 3)
+    except ValueError:
+        retries = 3
+    try:
+        base = float(os.environ.get("XFLOW_RENDEZVOUS_BACKOFF_S", "1.0") or 1.0)
+    except ValueError:
+        base = 1.0
+    return max(retries, 0), max(base, 0.0)
+
+
+def _initialize_with_retry(**kwargs) -> None:
+    """`jax.distributed.initialize` under bounded backoff+jitter retry
+    (launch/supervise.retry_call — the same primitive the supervision
+    loop uses), shutting the runtime down between attempts."""
+    from xflow_tpu.launch.supervise import retry_call
+
+    retries, base = _rendezvous_retry_env()
+
+    def cleanup():
+        jax.distributed.shutdown()
+
+    retry_call(
+        lambda: jax.distributed.initialize(**kwargs),
+        what="rendezvous",
+        retries=retries,
+        base_s=base,
+        cap_s=30.0,
+        cleanup=cleanup,
+    )
 
 
 def maybe_initialize(
@@ -42,10 +93,10 @@ def maybe_initialize(
         # topology: a no-arg initialize reads it from the runtime
         # metadata, so a pod launch needs no XFLOW_* contract at all —
         # export XFLOW_AUTO_DIST=1 on every worker (docs/DISTRIBUTED.md)
-        jax.distributed.initialize()
+        _initialize_with_retry()
         return jax.process_index()
     if coordinator and num_processes > 1:
-        jax.distributed.initialize(
+        _initialize_with_retry(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,

@@ -66,6 +66,20 @@ def resolve_run_id() -> str:
     return _RUN_ID
 
 
+def resolve_restart_gen() -> int:
+    """This process's restart generation: 0 on a first launch, k after
+    the k-th supervised auto-restart (launch/supervise.py exports
+    XFLOW_RESTART_GEN to every rank). Stamped as `gen` into every JSONL
+    record (jsonl.JsonlAppender) so one run's multi-generation streams
+    segment cleanly — step counts restart from 0 inside each generation,
+    and metrics_report.py keys its per-stream gates on (run_id, rank,
+    kind, gen)."""
+    try:
+        return int(os.environ.get("XFLOW_RESTART_GEN", "0") or 0)
+    except ValueError:
+        return 0
+
+
 def resolve_rank() -> int:
     """This process's rank for record stamping. The launcher env
     (XFLOW_PROCESS_ID) is authoritative and avoids touching jax from

@@ -16,6 +16,11 @@ real checkpoint dir:
   feature-less lines, separators-only lines, and a truncated final
   line — the bad-record quarantine's trigger (`data.max_bad_rows`) and
   the counter/parser parity tests' input.
+- `kill_step_from_env` / `hard_kill`: env-gated SIGKILL at step K
+  (generation-gated so a supervised relaunch survives) and
+  `abort_after_step`: the in-process crash analog — the elastic
+  recovery layer's triggers (supervised auto-restart + exact data
+  resume, docs/ROBUSTNESS.md "Elastic recovery").
 
 The reference has no analog: it neither checkpoints nor validates input
 (SURVEY.md §5 A3), so every one of these faults is either fatal or
@@ -80,18 +85,37 @@ def _apply(path: str, mode: str, **kw) -> str:
 
 
 def corrupt_npz_checkpoint(ckpt_dir: str, step: Optional[int] = None,
-                           mode: str = "truncate", **kw) -> str:
-    """Corrupt `state.npz` of the newest (or given) COMMITTED checkpoint.
+                           mode: str = "truncate", target: str = "state",
+                           **kw) -> str:
+    """Corrupt a file of the newest (or given) COMMITTED checkpoint.
     The commit marker is left intact — the point is a checkpoint that
-    LOOKS valid and fails only when read, the case restore_any heals."""
-    from xflow_tpu.train.checkpoint import committed_steps
+    LOOKS valid and fails only when read.
+
+    target="state" (default): `state.npz` — the case restore_any heals
+    by walking back to the previous committed step.
+    target="data_state": `data_state.json` (elastic recovery) — the
+    case read_data_state DOWNGRADES: the model still restores, the run
+    resumes with a fresh stream, and the downgrade is logged. Operators
+    drill both through tools/corrupt_ckpt.py."""
+    from xflow_tpu.train.checkpoint import committed_steps, data_state_path
 
     if step is None:
         steps = committed_steps(ckpt_dir)
         if not steps:
             raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir!r}")
         step = steps[0]
-    return _apply(os.path.join(ckpt_dir, f"step_{step}", "state.npz"), mode, **kw)
+    if target == "data_state":
+        victim = data_state_path(ckpt_dir, step, fmt="npz")
+        if not os.path.exists(victim):
+            raise FileNotFoundError(
+                f"checkpoint step {step} has no data_state (pre-v2 "
+                f"checkpoint?) under {ckpt_dir!r}"
+            )
+    elif target == "state":
+        victim = os.path.join(ckpt_dir, f"step_{step}", "state.npz")
+    else:
+        raise ValueError(f"target={target!r}: expected state|data_state")
+    return _apply(victim, mode, **kw)
 
 
 def corrupt_orbax_checkpoint(ckpt_dir: str, step: Optional[int] = None,
@@ -115,6 +139,16 @@ def corrupt_orbax_checkpoint(ckpt_dir: str, step: Optional[int] = None,
             raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir!r}")
         step = steps[0]
     root = os.path.join(ckpt_dir, f"orbax_step_{step}")
+    if target == "data_state":
+        from xflow_tpu.train.checkpoint import data_state_path
+
+        victim = data_state_path(ckpt_dir, step, fmt="orbax")
+        if not os.path.exists(victim):
+            raise FileNotFoundError(
+                f"orbax step {step} has no data_state sibling under "
+                f"{ckpt_dir!r}"
+            )
+        return _apply(victim, mode, **kw)
     if target == "manifest":
         victim = os.path.join(root, "manifest.ocdbt")
         if not os.path.exists(victim):
@@ -130,8 +164,88 @@ def corrupt_orbax_checkpoint(ckpt_dir: str, step: Optional[int] = None,
         if victim is None:
             raise FileNotFoundError(f"no files under {root!r}")
     else:
-        raise ValueError(f"target={target!r}: expected manifest|largest")
+        raise ValueError(
+            f"target={target!r}: expected manifest|largest|data_state"
+        )
     return _apply(victim, mode, **kw)
+
+
+# -------------------------------------------------------------- kill faults
+def kill_step_from_env(rank: int) -> int:
+    """1-based step at which this rank hard-kills itself (0 = off) — the
+    elastic-recovery drill injector, resolved ONCE at fit() start like
+    the pacing faults (zero per-step cost when unset).
+
+    Env contract (the launch-local auto-restart drill exports these):
+    - XFLOW_FAULT_KILL_STEP: SIGKILL this process the moment that
+      1-based step completes (after its heartbeat/checkpoint cadence
+      ran, so a kill on a checkpoint boundary leaves that step
+      committed) — a preemption without grace.
+    - XFLOW_FAULT_KILL_RANK: restrict the kill to one rank (default:
+      all ranks).
+    - XFLOW_FAULT_KILL_GEN (default 0): only kill in this restart
+      generation — the supervised relaunch (which inherits the env)
+      must survive, not die at step K forever.
+    """
+    try:
+        step = int(os.environ.get("XFLOW_FAULT_KILL_STEP", 0) or 0)
+    except ValueError:
+        return 0
+    if step <= 0:
+        return 0
+    r = os.environ.get("XFLOW_FAULT_KILL_RANK")
+    if r is not None:
+        try:
+            if int(r) != rank:
+                return 0
+        except ValueError:
+            return 0
+    from xflow_tpu.telemetry import resolve_restart_gen
+
+    try:
+        want_gen = int(os.environ.get("XFLOW_FAULT_KILL_GEN", 0) or 0)
+    except ValueError:
+        want_gen = 0
+    return step if resolve_restart_gen() == want_gen else 0
+
+
+def hard_kill() -> None:
+    """SIGKILL this process — no atexit, no finally blocks, no flushes
+    beyond what already hit the disk: the closest userspace emulation of
+    a preempted/OOM-killed host."""
+    import signal
+
+    try:
+        os.kill(os.getpid(), signal.SIGKILL)
+    except (OSError, AttributeError):
+        pass
+    os._exit(137)  # unreachable on POSIX; belt for exotic platforms
+
+
+def abort_after_step(trainer, step: int) -> None:
+    """Make the trainer's TRAINING stream raise RuntimeError right after
+    the 1-based global step `step`'s batch is consumed — the in-process
+    analog of a mid-run crash (the subprocess drills use
+    kill_step_from_env instead). Checkpoints committed up to the abort
+    survive, so a resume exercises the exact-stream data_state path;
+    eval streams pass through untouched (same seam and counting rule as
+    poison_nan_batches)."""
+    orig = trainer._coordinated_batches
+    counter = [0]
+
+    def wrapped(path, *args, **kwargs):
+        training = kwargs.get("enforce_bad_rows", True)
+        for batch, arrays in orig(path, *args, **kwargs):
+            yield batch, arrays
+            if training:
+                counter[0] += 1
+                if counter[0] >= step:
+                    raise RuntimeError(
+                        f"injected abort after step {counter[0]} "
+                        "(testing/faults.abort_after_step)"
+                    )
+
+    trainer._coordinated_batches = wrapped
 
 
 # ------------------------------------------------------------ pacing faults

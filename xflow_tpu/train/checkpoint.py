@@ -29,6 +29,57 @@ from xflow_tpu.train.state import TrainState
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
+# checkpoint metadata version (meta.json "version"):
+#   (absent) — pre-elastic-recovery checkpoints: model state only
+#   2 — adds the host-side data_state.json (exact data-pipeline resume)
+# Readers NEVER require the new pieces: a version-less checkpoint (or a
+# v2 one whose data_state was lost/truncated) restores the model and
+# resumes with a fresh stream, logging the downgrade (read_data_state).
+CHECKPOINT_VERSION = 2
+DATA_STATE_FILE = "data_state.json"
+
+
+def data_state_path(ckpt_dir: str, step: int, fmt: str = "npz") -> str:
+    """Where a step's data_state JSON lives: inside the npz step dir
+    (pruned with it), or as an `orbax_step_N.data_state.json` sibling
+    for orbax (orbax owns its dir's contents; the sibling is written
+    after the orbax save finalizes, so its presence implies a committed
+    checkpoint — and its absence is just the fresh-stream downgrade)."""
+    if fmt == "orbax":
+        return os.path.join(ckpt_dir, f"orbax_step_{step}.data_state.json")
+    return os.path.join(ckpt_dir, f"step_{step}", DATA_STATE_FILE)
+
+
+def read_data_state(ckpt_dir: str, step: int, fmt: str = "npz") -> Optional[dict]:
+    """The data-pipeline position saved alongside checkpoint `step`, or
+    None with a logged downgrade when it is missing (a pre-v2
+    checkpoint) or unreadable (truncated/corrupt JSON) — exact stream
+    resume is an upgrade, never a gate: the model state still restores
+    and the run resumes with a fresh stream (docs/ROBUSTNESS.md)."""
+    path = data_state_path(ckpt_dir, step, fmt)
+    if not os.path.exists(path):
+        print(
+            f"# checkpoint: step {step} has no data_state (pre-v2 "
+            "checkpoint?); resuming with a fresh data stream",
+            file=sys.stderr,
+        )
+        return None
+    try:
+        with open(path) as f:
+            ds = json.load(f)
+        if not isinstance(ds, dict):
+            raise ValueError(f"expected a JSON object, got {type(ds).__name__}")
+    except Exception as e:  # noqa: BLE001 — any unreadable data_state
+        # (truncation, bit rot, bad hand edit) downgrades, never kills
+        # the resume the model checkpoint itself supports
+        print(
+            f"# checkpoint: step {step} data_state unreadable "
+            f"({type(e).__name__}: {e}); resuming with a fresh data stream",
+            file=sys.stderr,
+        )
+        return None
+    return ds
+
 
 def _to_host(arr) -> np.ndarray:
     """Fetch a (possibly cross-process-sharded) array to every host."""
@@ -88,8 +139,20 @@ def _write_atomic(path: str, writer) -> None:
             os.remove(tmp)
 
 
-def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None) -> str:
+def save(
+    ckpt_dir: str,
+    state: TrainState,
+    logical_widths: Optional[dict] = None,
+    data_state: Optional[dict] = None,
+) -> str:
     """Write a checkpoint; returns its path.
+
+    `data_state` (optional) is the host-side data-pipeline position —
+    epoch index, batch offset, per-rank consumed-examples counters,
+    quarantine count (trainer._data_state_record) — written atomically
+    as data_state.json BEFORE the COMMITTED marker, so a committed
+    checkpoint either carries a complete data_state or (pre-v2 /
+    data_state=None) none at all, never a torn one.
 
     Host-gathered npz format: in multi-process mode every rank gathers
     (the allgather is collective) but only process 0 writes. Fine up to
@@ -123,6 +186,7 @@ def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None
             "step": step,
             "tables": sorted(state.tables),
             "format": "npz",
+            "version": CHECKPOINT_VERSION,
         }
 
         def write_json(p):
@@ -130,6 +194,13 @@ def save(ckpt_dir: str, state: TrainState, logical_widths: Optional[dict] = None
                 json.dump(meta, f)
 
         _write_atomic(os.path.join(path, "meta.json"), write_json)
+        if data_state is not None:
+
+            def write_ds(p):
+                with open(p, "w") as f:
+                    json.dump(data_state, f)
+
+            _write_atomic(os.path.join(path, DATA_STATE_FILE), write_ds)
 
         def write_marker(p):
             with open(p, "w") as f:
@@ -175,7 +246,14 @@ def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
         return removed
     if fmt == "orbax":
         steps = orbax_steps(ckpt_dir)
-        doomed = [f"orbax_step_{s}" for s in (steps[keep:] if keep > 0 else [])]
+        doomed = []
+        for s in steps[keep:] if keep > 0 else []:
+            # a pruned orbax step takes its sibling data_state file with
+            # it — an orphaned data_state would pair with the WRONG
+            # stream position if that step number ever recurs
+            doomed.extend(
+                [f"orbax_step_{s}", os.path.basename(data_state_path(ckpt_dir, s, "orbax"))]
+            )
         # stale-debris sweep, orbax flavor: a save killed mid-write leaves
         # orbax's own temp dir (`orbax_step_N.orbax-checkpoint-tmp-...`),
         # which never matches orbax_steps and would leak forever
@@ -192,7 +270,15 @@ def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
                 doomed.append(name)
     for name in doomed:
         p = os.path.join(ckpt_dir, name)
-        shutil.rmtree(p, ignore_errors=True)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            try:
+                os.remove(p)  # plain files: the orbax data_state siblings
+            except OSError:
+                pass
+        else:
+            continue
         removed.append(p)
     return removed
 
@@ -384,13 +470,25 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
 # directly (OCDBT), so no host ever materializes the full table, and
 # restore places shards straight onto the target sharding.
 
-def save_orbax(ckpt_dir: str, state: TrainState) -> str:
+def save_orbax(
+    ckpt_dir: str, state: TrainState, data_state: Optional[dict] = None
+) -> str:
     import orbax.checkpoint as ocp
 
     step = int(state.step)
     path = os.path.abspath(os.path.join(ckpt_dir, f"orbax_step_{step}"))
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state._asdict(), force=True)
+    if data_state is not None and jax.process_index() == 0:
+        # sibling file, written AFTER orbax finalizes its rename-commit:
+        # its presence implies a committed checkpoint, its absence (an
+        # old checkpoint, a crash in this window) is the fresh-stream
+        # downgrade read_data_state already handles
+        def write_ds(p):
+            with open(p, "w") as f:
+                json.dump(data_state, f)
+
+        _write_atomic(data_state_path(ckpt_dir, step, fmt="orbax"), write_ds)
     return path
 
 

@@ -330,6 +330,14 @@ class Trainer:
             cfg.train.heartbeat_path,
             stamp={"rank": self.rank, "run_id": self.run_id, "kind": "heartbeat"},
         )
+        # data-stream position for exact resume (elastic recovery,
+        # docs/ROBUSTNESS.md): (epoch, batches consumed within it),
+        # maintained by the fit loop and snapshotted into every
+        # checkpoint's data_state; _resume_data_state holds what
+        # maybe_restore read back, consumed by the next fit()
+        self._epoch_pos = (0, 0)
+        self._examples_seen = 0
+        self._resume_data_state: Optional[dict] = None
         # validate the guard mode at CONSTRUCTION (identical config on
         # every rank → rank-symmetric), not on the first bad batch
         self._guarded = nonfinite_guard_on(cfg)
@@ -613,8 +621,11 @@ class Trainer:
             row_mask=np.zeros((B,), np.float32),
         )
 
-    def _global_batch_count(self, path: str) -> tuple[int, int]:
-        """(global_steps, local_batches) for one pass over `path`.
+    def _global_batch_count(self, path: str, skip: int = 0) -> tuple[int, int]:
+        """(global_steps, local_batches) for one pass over `path`,
+        with the first `skip` batches fast-forwarded (data_state
+        resume; `skip` is the GLOBAL within-epoch offset, identical on
+        every rank, so the subtraction is rank-symmetric).
 
         SPMD steps are collective: if process A has 10 batches and process
         B has 9 (ragged shards — the reference tolerates this because its
@@ -632,6 +643,7 @@ class Trainer:
             local = count_batches(path, self.cfg.data)
         except FileNotFoundError:
             local = 0
+        local = max(local - max(int(skip), 0), 0)
         from jax.experimental import multihost_utils
 
         counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
@@ -655,6 +667,7 @@ class Trainer:
         enforce_bad_rows: bool = True,
         quarantine: bool = True,
         track_health: bool = True,
+        skip: int = 0,
     ):
         """Yield exactly the globally-agreed number of (batch, arrays)
         pairs for `path`, padding with fully-masked empty batches once
@@ -666,7 +679,11 @@ class Trainer:
         this iterator's). `with_plan` false skips sorted-plan building
         (mesh eval runs row-major); `enforce_bad_rows`/`quarantine`
         thread through to the bad-record monitor (eval passes count but
-        never raise; only the first training pass quarantines)."""
+        never raise; only the first training pass quarantines). `skip`
+        fast-forwards the stream past its first `skip` batches
+        (checkpointed data_state resume, data/pipeline.skip_batches) —
+        the skipped prefix is neither planned, monitored, nor counted
+        toward this pass's coordinated step total."""
 
         prepare = lambda b: self._with_arrays(
             b, with_plan=with_plan, track_health=track_health
@@ -680,13 +697,14 @@ class Trainer:
             for b in batch_iterator(
                 path, self.cfg.data,
                 enforce_bad_rows=enforce_bad_rows, quarantine=quarantine,
+                skip=skip,
             ):
                 yield prepare(b)
 
         if jax.process_count() == 1:
             yield from prefetch(feed())
             return
-        global_steps, local = self._global_batch_count(path)
+        global_steps, local = self._global_batch_count(path, skip=skip)
         # open the real iterator whenever the file exists (even if counted
         # 0) so the drift check below can catch a counter that under-reads
         it = iter(prefetch(feed())) if os.path.exists(path) else iter(())
@@ -789,11 +807,12 @@ class Trainer:
         # step completes for train.hang_timeout_s
         dump_restore = install_stack_dump_handler()
         hang = HangWatchdog(cfg.train.hang_timeout_s)
-        # straggler/stall drill injectors (testing/faults.py): env-gated,
-        # resolved ONCE here — zero per-step cost in real runs
-        from xflow_tpu.testing.faults import fit_delays_from_env
+        # straggler/stall/kill drill injectors (testing/faults.py):
+        # env-gated, resolved ONCE here — zero per-step cost in real runs
+        from xflow_tpu.testing.faults import fit_delays_from_env, kill_step_from_env
 
         step_delay_s, stall_step, stall_s = fit_delays_from_env(self.rank)
+        kill_step = kill_step_from_env(self.rank)
         hb_every = cfg.train.heartbeat_every
         if cfg.train.eval_every and not cfg.data.test_path:
             # the eval_every gate below requires a holdout; say so once
@@ -870,14 +889,24 @@ class Trainer:
                 sig_flag["sig"] = got  # adopt the peer's signal for reporting
             return got
 
+        # exact data resume (elastic recovery, docs/ROBUSTNESS.md): a
+        # restored checkpoint's data_state pins the stream position the
+        # run stopped at; this fit continues there instead of replaying
+        # already-trained records from row 0
+        start_epoch, resume_skip = self._consume_resume_position()
+        self._epoch_pos = (start_epoch, resume_skip)
         stop_sig = 0
         try:
-            for epoch in range(cfg.train.epochs):
+            for epoch in range(start_epoch, cfg.train.epochs):
+                # the resume offset applies to the FIRST (partially
+                # consumed) epoch only; later epochs read from row 0
+                skip = resume_skip if epoch == start_epoch else 0
+                steps_in_epoch = skip
                 # quarantine on the FIRST pass only: later epochs see the
                 # same bad rows again (still counted/enforced), and one
                 # record per bad row beats epochs× duplicates
                 for batch, arrays in steptimer.batches(
-                    self._coordinated_batches(path, quarantine=epoch == 0)
+                    self._coordinated_batches(path, quarantine=epoch == 0, skip=skip)
                 ):
                     trace.before_step(res.steps + 1)
                     if step_delay_s:  # drill injector (testing/faults.py)
@@ -898,6 +927,10 @@ class Trainer:
                     last_metrics = m
                     res.steps += 1
                     res.examples += batch.num_rows
+                    steps_in_epoch += 1
+                    self._examples_seen += batch.num_rows
+                    # the position the NEXT checkpoint's data_state pins
+                    self._epoch_pos = (epoch, steps_in_epoch)
                     if hb_every and res.steps % hb_every == 0:
                         self.heartbeat.append({"step": res.steps})
                     if stall_s and res.steps == stall_step:
@@ -946,14 +979,41 @@ class Trainer:
                         and cfg.train.checkpoint_every
                         and res.steps % cfg.train.checkpoint_every == 0
                     ):
+                        # bracket the (possibly minutes-long collective)
+                        # save with beats: no train step completes inside
+                        # it, and under a supervised launch a false dead
+                        # verdict is a TEARDOWN, not just a warning —
+                        # operators still must keep dead_after_s above
+                        # the save duration itself
+                        self.heartbeat.append(
+                            {"step": res.steps, "event": "checkpoint"}
+                        )
                         self.save_checkpoint()
+                        self.heartbeat.append({"step": res.steps})
                         hang.tick()  # a slow collective save is progress
+                    if kill_step and res.steps == kill_step:
+                        # elastic-recovery drill (testing/faults.py):
+                        # SIGKILL AFTER the checkpoint cadence above, so
+                        # a kill on a boundary leaves that step committed
+                        from xflow_tpu.testing.faults import hard_kill
+
+                        print(
+                            f"xflow: fault injector: hard-killing rank "
+                            f"{self.rank} at step {res.steps} "
+                            "(XFLOW_FAULT_KILL_STEP)",
+                            file=sys.stderr, flush=True,
+                        )
+                        hard_kill()
                     if not multiproc or (sync_every and res.steps % sync_every == 0):
                         stop_sig = coordinated_signal()
                         if stop_sig:
                             break
                 if halted:
                     break
+                if not stop_sig:
+                    # epoch consumed in full: the stream position rolls
+                    # over (an interrupted epoch keeps its mid-epoch pos)
+                    self._epoch_pos = (epoch + 1, 0)
                 res.epochs = epoch + (0 if stop_sig else 1)
                 if not stop_sig:
                     if (epoch + 1) % 30 == 0:
@@ -971,9 +1031,14 @@ class Trainer:
                         # bracket it with ticks so a long (healthy)
                         # holdout doesn't read as a hang — at most one
                         # dump can fire, and only if the eval ITSELF
-                        # exceeds the timeout
+                        # exceeds the timeout. Same bracketing for the
+                        # heartbeat stream: a quiet holdout pass must
+                        # not age into a dead verdict (which a
+                        # supervised launcher acts on, not just logs)
                         hang.tick()
+                        self.heartbeat.append({"step": res.steps, "event": "eval"})
                         auc, ll = self.evaluate(dump=False, streaming=True)
+                        self.heartbeat.append({"step": res.steps})
                         hang.tick()
                         # strict JSON: a one-class shard's NaN AUC logs
                         # as null, same convention as the guarded loss
@@ -1256,17 +1321,101 @@ class Trainer:
         return auc, ll_sum / n_rows
 
     # ------------------------------------------------------------- checkpoint
+    def _data_state_record(self) -> dict:
+        """The host-side data-pipeline position saved alongside every
+        checkpoint (elastic recovery, docs/ROBUSTNESS.md): epoch index,
+        batches consumed within it (the GLOBAL coordinated count — each
+        rank's local offset on resume is min(batches, its shard's batch
+        count), which the skip iterator realizes for free), cumulative
+        per-rank examples, and the quarantine count. `completed` marks
+        a checkpoint written after the configured epochs all ran — a
+        resume of a completed run is continuation training and starts a
+        fresh pass instead of training nothing. The stream itself is
+        deterministic file order (no shuffle stage yet); when one
+        lands, its RNG state joins this record — the version field
+        exists for exactly that."""
+        epoch, batches = self._epoch_pos
+        reg = default_registry()
+        ds = {
+            "version": 1,
+            "epoch": int(epoch),
+            "batches": int(batches),
+            "completed": bool(epoch >= self.cfg.train.epochs),
+            "examples": int(self._examples_seen),
+            "quarantined_rows": int(reg.counter("data.quarantined_rows").value),
+        }
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            # collective-safe: save_checkpoint is itself collective, so
+            # every rank reaches this allgather at the same step.
+            # int32: jax without x64 silently truncates int64 inputs
+            per_rank = np.asarray(
+                multihost_utils.process_allgather(
+                    np.int32(min(self._examples_seen, 2**31 - 1))
+                )
+            ).reshape(-1)
+            ds["examples_per_rank"] = [int(x) for x in per_rank]
+        return ds
+
+    def _consume_resume_position(self) -> tuple[int, int]:
+        """(start_epoch, batch_offset) for this fit(), consuming the
+        data_state maybe_restore captured. Fresh runs, pre-v2
+        checkpoints, unreadable data_state, and COMPLETED checkpoints
+        (continuation training) all start at (0, 0); an interrupted
+        run's checkpoint resumes the stream exactly where it stopped."""
+        ds = self._resume_data_state
+        self._resume_data_state = None
+        if not isinstance(ds, dict) or ds.get("completed"):
+            return 0, 0
+        try:
+            epoch = max(int(ds.get("epoch", 0)), 0)
+            batches = max(int(ds.get("batches", 0)), 0)
+            # THIS rank's consumed-example counter, not rank 0's: on
+            # ragged shards the counts differ per rank, and adopting the
+            # writer's scalar would inflate every later checkpoint's
+            # accounting on the shorter ranks
+            per_rank = ds.get("examples_per_rank")
+            if isinstance(per_rank, list) and self.rank < len(per_rank):
+                self._examples_seen = max(int(per_rank[self.rank]), 0)
+            else:
+                self._examples_seen = max(int(ds.get("examples", 0)), 0)
+        except (TypeError, ValueError):
+            print(
+                "xflow: warning: checkpoint data_state is malformed; "
+                "resuming with a fresh data stream",
+                file=sys.stderr,
+            )
+            return 0, 0
+        if epoch or batches:
+            from xflow_tpu.telemetry import resolve_restart_gen
+
+            print(
+                f"resuming data stream at epoch {epoch}, batch offset "
+                f"{batches} (restart generation {resolve_restart_gen()})",
+                file=sys.stderr,
+            )
+        return epoch, batches
+
     def save_checkpoint(self) -> None:
         from xflow_tpu.train import checkpoint as ckpt
 
+        data_state = self._data_state_record()
         if self.cfg.train.checkpoint_format == "orbax":
             # orbax stores the device arrays in their NATIVE (possibly
             # packed) layout, shard-parallel; npz stores the LOGICAL
             # layout so export tools and differently-configured runs
             # read one format
-            ckpt.save_orbax(self.cfg.train.checkpoint_dir, self.state)
+            ckpt.save_orbax(
+                self.cfg.train.checkpoint_dir, self.state, data_state=data_state
+            )
         else:
-            ckpt.save(self.cfg.train.checkpoint_dir, self.state, self._logical_widths())
+            ckpt.save(
+                self.cfg.train.checkpoint_dir,
+                self.state,
+                self._logical_widths(),
+                data_state=data_state,
+            )
         # retention + stale-uncommitted sweep AFTER the commit: the save
         # that just landed proves no writer owns the swept debris
         ckpt.prune_checkpoints(
@@ -1305,9 +1454,14 @@ class Trainer:
         # what it skipped and why). No checkpoint at all = fresh start;
         # raises only when checkpoints exist and NONE loads.
         try:
-            self.state, _ = ckpt.restore_any(cdir, self.state, fmt=fmt)
+            self.state, step = ckpt.restore_any(cdir, self.state, fmt=fmt)
         except FileNotFoundError:
             return False
+        # the data-stream position travels with the step that actually
+        # restored (a walk-back must not pair step N-1's weights with
+        # step N's stream offset); missing/unreadable data_state
+        # downgrades to a fresh stream inside read_data_state
+        self._resume_data_state = ckpt.read_data_state(cdir, step, fmt=fmt)
         return True
 
 
