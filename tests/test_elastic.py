@@ -63,19 +63,25 @@ def test_checkpoint_carries_versioned_data_state(dataset, tmp_path):
     Trainer(cfg).fit()
     steps = committed_steps(str(ck))
     assert steps == [12, 10, 5]
-    # mid-run checkpoint: mid-stream position, not completed
+    # mid-run checkpoint: mid-stream position, not completed — the
+    # topology-independent v2 form: global examples + per-SHARD offsets
     ds5 = read_data_state(str(ck), 5)
     assert ds5 == {
-        "version": 1, "epoch": 0, "batches": 5, "completed": False,
-        "examples": 500, "quarantined_rows": 0,
+        "version": 2, "epoch": 0, "batches": 5, "completed": False,
+        "examples": 500, "examples_per_rank": [500],
+        "shard_batches": {"0": 5}, "num_shards": 1, "world_size": 1,
+        "quarantined_rows": 0,
     }
     # final checkpoint: all epochs consumed, completed
     ds12 = read_data_state(str(ck), 12)
     assert ds12["completed"] and ds12["epoch"] == 2 and ds12["batches"] == 0
     assert ds12["examples"] == 1200
-    # the metadata carries the version field (satellite: versioned format)
+    # the metadata carries version + logical layout + per-array digests
+    # (checkpoint v3: topology-elastic, integrity-verified)
     meta = json.load(open(ck / "step_12" / "meta.json"))
-    assert meta["version"] == 2
+    assert meta["version"] == 3 and meta["world_size"] == 1
+    assert meta["layout"]["tables/w"] == [4096]
+    assert meta["digests"]["tables/w"].startswith("crc32:")
 
 
 def test_read_data_state_missing_downgrades(dataset, tmp_path, capsys):
@@ -91,7 +97,7 @@ def test_read_data_state_missing_downgrades(dataset, tmp_path, capsys):
     # the resume itself still works: model restores, stream starts fresh
     t2 = Trainer(cfg)
     assert t2.maybe_restore() and int(t2.state.step) == 12
-    assert t2._consume_resume_position() == (0, 0)
+    assert t2._consume_resume_position() == (0, {})
 
 
 def test_read_data_state_truncated_downgrades(dataset, tmp_path, capsys):
@@ -192,8 +198,10 @@ def test_resume_mid_later_epoch(dataset, tmp_path):
         t1.fit()
     assert committed_steps(ck) == [8]
     assert read_data_state(ck, 8) == {
-        "version": 1, "epoch": 1, "batches": 2, "completed": False,
-        "examples": 800, "quarantined_rows": 0,
+        "version": 2, "epoch": 1, "batches": 2, "completed": False,
+        "examples": 800, "examples_per_rank": [800],
+        "shard_batches": {"0": 2}, "num_shards": 1, "world_size": 1,
+        "quarantined_rows": 0,
     }
     t2 = Trainer(cfg)
     assert t2.maybe_restore()
@@ -201,26 +209,41 @@ def test_resume_mid_later_epoch(dataset, tmp_path):
     assert res.steps == 4 and int(t2.state.step) == 12
 
 
-def test_resume_restores_this_ranks_example_counter(dataset):
-    """On ragged shards the per-rank consumed-example counts differ;
-    each rank must restore ITS OWN counter from examples_per_rank, not
-    adopt the writer's (rank 0's) scalar — that would inflate every
-    later checkpoint's accounting on the shorter ranks."""
+def test_resume_restores_global_example_accounting(dataset):
+    """Example accounting is GLOBAL and topology-independent (v2):
+    the restored total becomes the base, each rank's local counter
+    restarts at 0, and the next checkpoint's `examples` = base + the
+    sum of this generation's per-rank counts — exact whatever the rank
+    counts on either side. A v1 per-rank-keyed record folds in by
+    summation (the satellite downgrade path), and its global
+    coordinated offset fans out to every shard (v1 runs consumed their
+    shards in lockstep, so the fold is exact)."""
     t = Trainer(make_cfg(dataset), process_index=1)
     t._resume_data_state = {
         "version": 1, "epoch": 0, "batches": 10, "completed": False,
         "examples": 1000, "examples_per_rank": [1000, 900],
     }
-    assert t._consume_resume_position() == (0, 10)
-    assert t._examples_seen == 900
-    # single-process / legacy data_state: the scalar is this rank's own
+    assert t._consume_resume_position() == (0, {0: 10, 1: 10})
+    assert t._examples_base == 1900 and t._examples_seen == 0
+    assert t._num_shards == 2
+    # single-process / legacy data_state: the scalar already is global
     t2 = Trainer(make_cfg(dataset))
     t2._resume_data_state = {
         "version": 1, "epoch": 1, "batches": 2, "completed": False,
         "examples": 800,
     }
-    assert t2._consume_resume_position() == (1, 2)
-    assert t2._examples_seen == 800
+    assert t2._consume_resume_position() == (1, {0: 2})
+    assert t2._examples_base == 800 and t2._examples_seen == 0
+    # v2 record: per-shard offsets pass through verbatim
+    t3 = Trainer(make_cfg(dataset))
+    t3._resume_data_state = {
+        "version": 2, "epoch": 0, "batches": 7, "completed": False,
+        "examples": 1400, "examples_per_rank": [700, 700],
+        "shard_batches": {"0": 7, "1": 4}, "num_shards": 2,
+        "world_size": 2,
+    }
+    assert t3._consume_resume_position() == (0, {0: 7, 1: 4})
+    assert t3._examples_base == 1400 and t3._num_shards == 2
 
 
 def test_completed_checkpoint_restarts_fresh_pass(dataset, tmp_path):
@@ -232,7 +255,7 @@ def test_completed_checkpoint_restarts_fresh_pass(dataset, tmp_path):
     Trainer(cfg).fit()
     t2 = Trainer(cfg)
     assert t2.maybe_restore()
-    assert t2._consume_resume_position() == (0, 0)
+    assert t2._consume_resume_position() == (0, {})
 
 
 def test_skip_batches_fast_forward(dataset):
@@ -485,7 +508,7 @@ def test_fold_heartbeats_tolerates_damaged_gen():
         {"ts": 3.0, "rank": 0, "run_id": "r", "gen": 1, "step": 3},
     ]
     beats = fold_heartbeats(recs, run_id="r", gen=1)
-    assert beats == {0: {"step": 3, "ts": 3.0, "event": None}}
+    assert beats == {0: {"step": 3, "ts": 3.0, "event": None, "gen": 1}}
 
 
 def test_heartbeat_brackets_eval_and_checkpoint(dataset, tmp_path):

@@ -284,7 +284,7 @@ def test_launch_local_supervised_auto_restart(tmp_path):
     assert "hard-killing rank 1 at step 4" in r.stderr
     assert "restarting generation 1" in r.stderr
     assert "resumed from step 4" in r.stderr
-    assert "resuming data stream at epoch 1, batch offset 1" in r.stderr
+    assert "resuming data stream at epoch 1, shard offsets [1, 1]" in r.stderr
     assert "job succeeded after 1 restart(s)" in r.stderr
 
     # generation 1's rank-0 summary: exactly the un-trained suffix
@@ -299,7 +299,12 @@ def test_launch_local_supervised_auto_restart(tmp_path):
     ck = str(tmp_path / "ckpt")
     assert latest_step(ck) == 6
     ds = read_data_state(ck, 6)
-    assert ds["completed"] and ds["examples_per_rank"] == [2 * rows, 2 * rows]
+    # GLOBAL accounting (v2 data_state): 2 shards x 96 rows x 2 epochs,
+    # every row exactly once; per-rank counts are this GENERATION's
+    # local consumption (steps 5-6 = 2 batches x 32 rows each)
+    assert ds["completed"] and ds["examples"] == 4 * rows
+    assert ds["examples_per_rank"] == [2 * B, 2 * B]
+    assert ds["world_size"] == 2 and ds["num_shards"] == 2
 
     # both generations landed in the run dir under ONE run_id, and the
     # schema gate accepts the multi-generation stream

@@ -10,11 +10,19 @@ committed step (docs/ROBUSTNESS.md).
     # truncate the newest npz checkpoint to half its bytes
     python tools/corrupt_ckpt.py --dir ckpt
 
-    # flip 8 random bits in a specific orbax step's data file
-    python tools/corrupt_ckpt.py --dir ckpt --format orbax \\
-        --step 1200 --mode bitflip
+    # SILENT corruption drill (checkpoint digests, docs/ROBUSTNESS.md):
+    # flip bytes inside the npz's array payload and rewrite the
+    # container, so every zip-level check still passes and only the
+    # meta.json per-array digests catch it on restore
+    python tools/corrupt_ckpt.py --dir ckpt --mode bitflip
 
-    # corrupt an arbitrary file (no checkpoint-layout assumptions)
+    # flip 8 random bits in a specific orbax step's data file (OCDBT
+    # reads are not checksum-verified — also a digest-layer drill)
+    python tools/corrupt_ckpt.py --dir ckpt --format orbax \\
+        --step 1200 --mode bitflip --target largest
+
+    # corrupt an arbitrary file (no checkpoint-layout assumptions;
+    # raw byte flips, so an npz fails at the zip layer instead)
     python tools/corrupt_ckpt.py --file ckpt/step_10/state.npz --mode truncate
 """
 

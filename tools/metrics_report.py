@@ -19,14 +19,17 @@ a warning, never an exception.
 `--check` validates the telemetry schema — every record stamped with
 ts/rank/run_id, step numbers monotone per stream, window records
 carrying the full decomposition key set, health fields all-or-none,
-eval and heartbeat records complete — and exits nonzero on any
-violation (tools/smoke_telemetry.sh gates on it).
+eval and heartbeat records complete, `world` stamps agreeing within
+each generation (the rank SET may change ACROSS generations: a
+degraded --allow-shrink relaunch is legitimate, not corruption) — and
+exits nonzero on any violation (tools/smoke_telemetry.sh gates on it).
 
 `--health` renders the model-health view: norm trends, loss EMA, the
 AUC trajectory, occupancy/collision gauges, and a per-rank heartbeat
 table (straggler/dead classification via launch/watchdog.py, with
 "now" = the newest heartbeat seen, so a finished run reads as
-finished, not dead).
+finished, not dead; a rank the supervisor shrank away reads as
+`retired@genK`, not dead).
 
 `--bench-json` emits a BENCH-style perf-trajectory record (the shape
 bench.py prints) computed from the run's own telemetry, so a training
@@ -202,10 +205,41 @@ def summarize_stream(records: list[dict]) -> dict:
 
 def check_streams(streams: dict, files: list[str]) -> list[str]:
     """Schema violations ([] = clean). The contract checked here is the
-    one docs/OBSERVABILITY.md documents — keep the three in sync."""
+    one docs/OBSERVABILITY.md documents — keep the three in sync.
+
+    Topology elasticity: the rank SET may legitimately change across
+    restart generations (--allow-shrink relaunches a degraded world
+    under the same run_id), so nothing here requires generation k+1 to
+    carry generation k's ranks. What IS enforced: within one (run_id,
+    generation), every `world` stamp agrees, and no training rank's id
+    is >= its generation's world size (the launcher's watchdog stream
+    stamps rank -1 and is exempt)."""
     problems: list[str] = []
     if not streams:
         problems.append(f"no records in {', '.join(files)}")
+    # (run_id, gen) -> set of world stamps seen (rank-set/world gate)
+    worlds: dict = {}
+    for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
+        rank_flagged = False  # one problem per stream, but keep
+        # collecting its world stamps — the intra-generation
+        # disagreement below is the more diagnostic signal
+        for rec in records:
+            w = rec.get("world")
+            if isinstance(w, int) and w > 0:
+                worlds.setdefault((run_id, gen), set()).add(w)
+                if not rank_flagged and isinstance(rank, int) and rank >= w:
+                    rank_flagged = True
+                    problems.append(
+                        f"run {run_id} rank {rank} [{kind}] gen {gen}: "
+                        f"rank id >= its generation's world size {w}"
+                    )
+    for (run_id, gen), seen in sorted(worlds.items(), key=str):
+        if len(seen) > 1:
+            problems.append(
+                f"run {run_id} gen {gen}: world stamp disagrees across "
+                f"streams ({sorted(seen)}) — ranks of one generation "
+                "launched with different world sizes"
+            )
     for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
         tag = f"run {run_id} rank {rank} [{kind}]" + (
             f" gen {gen}" if gen else ""
@@ -369,20 +403,47 @@ def heartbeat_rows(streams: dict, run_id: str) -> list[dict]:
     via the same fold + classifier the live launcher watchdog uses —
     with "now" anchored to the newest heartbeat anywhere in the run
     (offline post-mortem: wall-clock now would read every finished run
-    as dead)."""
+    as dead).
+
+    Topology elasticity: a rank the --allow-shrink supervisor dropped
+    stops beating at its last generation and never writes a final
+    event — wall-clock classification would call it dead forever. When
+    the run's NEWEST generation stamps a smaller world, ranks outside
+    that world whose beats stop at an older generation are relabeled
+    ``retired@genK`` (K = the last generation they served in)."""
     from xflow_tpu.launch.watchdog import classify, fold_heartbeats
 
     beats: dict = {}
-    for (rid, _rank, kind, _gen), recs in streams.items():
-        if rid == run_id and kind == "heartbeat":
+    latest_gen = 0
+    world_by_gen: dict = {}
+    for (rid, _rank, kind, gen), recs in streams.items():
+        if rid != run_id:
+            continue
+        latest_gen = max(latest_gen, gen)
+        if kind == "heartbeat":
             # generations fold together: the newest beat per rank wins,
             # so a rank that died in gen k and finished in gen k+1
             # correctly reads as finished
             fold_heartbeats(recs, beats)
+        for r in recs:
+            w = r.get("world")
+            if isinstance(w, int) and w > 0:
+                world_by_gen[gen] = max(world_by_gen.get(gen, 0), w)
     if not beats:
         return []
     now = max(b["ts"] for b in beats.values())
-    return classify(beats, now)
+    rows = classify(beats, now)
+    cur_world = world_by_gen.get(latest_gen, 0)
+    for row in rows:
+        beat_gen = beats.get(row["rank"], {}).get("gen", 0)
+        if (
+            cur_world
+            and row["rank"] >= cur_world
+            and beat_gen < latest_gen
+            and row["status"] not in ("finished",)
+        ):
+            row["status"] = f"retired@gen{beat_gen}"
+    return rows
 
 
 def render_health(streams: dict) -> str:
@@ -432,7 +493,12 @@ def render_health(streams: dict) -> str:
     if hb:
         lines.append("  heartbeats (lowest step first = the culprit ordering):")
         for row in hb:
-            flag = "" if row["status"] in ("ok", "finished") else "  <-- " + row["status"].upper()
+            # retired@genK is a NEUTRAL state (the supervisor shrank
+            # that rank away on purpose), not an alert like dead
+            neutral = row["status"] in ("ok", "finished") or row[
+                "status"
+            ].startswith("retired")
+            flag = "" if neutral else "  <-- " + row["status"].upper()
             lines.append(
                 f"    rank {row['rank']}: step {row['step']}/{row['max_step']}"
                 f"  last beat {row['age_s']:.1f}s before run end"

@@ -306,6 +306,14 @@ class TrainConfig:
     # forever would burn an epoch of compute learning nothing.
     # 0 = never abort.
     nonfinite_max_consecutive: int = 10
+    # digest verification on restore (docs/ROBUSTNESS.md "Silent shard
+    # corruption"): "auto" checks every stored array read against the
+    # per-array digests meta.json recorded at save (checkpoint v3) —
+    # a mismatch is a logged CheckpointDigestError and restore_any
+    # walks back to the previous committed step; arrays without
+    # digests (pre-v3 checkpoints, pod-scale multi-process orbax
+    # saves) restore unverified. "off" skips the check entirely.
+    checkpoint_verify: str = "auto"
     # checkpoint retention: keep the N newest COMMITTED checkpoints
     # and sweep stale uncommitted step dirs after each save (a crashed
     # save leaves a partial dir; readers already ignore it, this

@@ -138,6 +138,36 @@ def examples_to_batches(
         yield make_batch(fields, slots, labels, batch_size, max_nnz)
 
 
+def assign_shards(
+    prefix: str, rank: int, world: int, num_shards: int = 0
+) -> list[tuple[int, str]]:
+    """Round-robin shard ownership for a topology-elastic world:
+    [(shard index, path)] for rank `rank` of `world`.
+
+    `num_shards` is the shard set in play — for a fresh run it equals
+    the world size, so rank k owns exactly shard k and this degrades to
+    the legacy one-shard-per-rank contract (`lr_worker.cc:210`)
+    byte-for-byte. On an elastic resume the trainer passes the
+    checkpoint data_state's `num_shards` (the ORIGINAL record set):
+
+    - shrink (world M < num_shards N): rank k owns shards k, k+M,
+      k+2M, ... — the surviving ranks cover the full record set, each
+      shard resuming at its own stored offset (`skip_batches`), so no
+      record trains twice and none is dropped;
+    - grow (world M > num_shards N): ranks N..M-1 own the shard of
+      their own index, which joins the record set if its file exists
+      (a missing shard is the existing ragged-shard tolerance: the
+      rank pads with empty batches).
+
+    Shard files need not exist — the batch counters treat a missing
+    path as 0 batches, matching the reference's idle-worker behavior.
+    """
+    n = max(int(num_shards), int(world), 1)
+    from xflow_tpu.data.libffm import shard_path
+
+    return [(s, shard_path(prefix, s)) for s in range(int(rank), n, int(world))]
+
+
 def skip_batches(
     batches: Iterator[SparseBatch], n: int
 ) -> Iterator[SparseBatch]:

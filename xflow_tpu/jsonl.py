@@ -50,6 +50,15 @@ class JsonlAppender:
             from xflow_tpu.telemetry import resolve_restart_gen
 
             self._static = {**self._static, "gen": resolve_restart_gen()}
+        if "world" not in self._static:
+            # the generation's world size (degraded-mode supervision,
+            # docs/ROBUSTNESS.md): a shrunk relaunch stamps its NEW rank
+            # count so report tools can tell a retired rank from a dead
+            # one — resolved lazily like gen, after the launcher env
+            # (XFLOW_NUM_PROCESSES) has settled
+            from xflow_tpu.telemetry import resolve_world_size
+
+            self._static = {**self._static, "world": resolve_world_size()}
         return self._static
 
     def append(self, record: dict) -> None:

@@ -45,6 +45,14 @@ def _add_supervise_flags(ap: argparse.ArgumentParser) -> None:
                     help="an attempt dying faster than this is treated as a "
                          "config error and NOT restarted (default 0 = always "
                          "restart while the budget lasts)")
+    ap.add_argument("--allow-shrink", action="store_true",
+                    help="degraded-mode supervision: a watchdog dead-HOST "
+                         "verdict (unreachable across the grace window, vs a "
+                         "process that merely exits) relaunches on the "
+                         "surviving host set with a recomputed world size; "
+                         "the elastic restore reshards the checkpoint and "
+                         "re-assigns the lost rank's data shards (default: "
+                         "relaunch same-shape)")
 
 
 def _add_watchdog_flags(ap: argparse.ArgumentParser) -> None:
@@ -209,7 +217,7 @@ def cmd_launch_local(args) -> int:
         straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
         watchdog_poll_s=args.watchdog_poll_s,
         max_restarts=args.max_restarts, restart_backoff=args.restart_backoff,
-        min_uptime_s=args.min_uptime_s,
+        min_uptime_s=args.min_uptime_s, allow_shrink=args.allow_shrink,
     )
 
 
@@ -235,7 +243,7 @@ def cmd_launch_dist(args) -> int:
         straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
         watchdog_poll_s=args.watchdog_poll_s,
         max_restarts=args.max_restarts, restart_backoff=args.restart_backoff,
-        min_uptime_s=args.min_uptime_s,
+        min_uptime_s=args.min_uptime_s, allow_shrink=args.allow_shrink,
     )
 
 

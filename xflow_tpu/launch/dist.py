@@ -122,6 +122,25 @@ def rank_command(
     )
 
 
+def probe_host(host: str, ssh_cmd: str = "ssh", timeout_s: float = 10.0) -> bool:
+    """One cheap reachability probe (`<ssh_cmd> host true`) — the
+    rejoin detector for degraded-mode supervision: before each shrunk
+    relaunch the launcher probes the hosts it lost, and one that
+    answers again rejoins the world at that relaunch (the next
+    checkpoint boundary's restore reshards onto the grown mesh)."""
+    try:
+        r = subprocess.run(
+            [*shlex.split(ssh_cmd), host, "true"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            stdin=subprocess.DEVNULL,
+            timeout=timeout_s,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def launch_dist(
     hosts: list[str],
     forward_args: list[str],
@@ -138,6 +157,7 @@ def launch_dist(
     max_restarts: int = 0,
     restart_backoff: float = 1.0,
     min_uptime_s: float = 0.0,
+    allow_shrink: bool = False,
 ) -> int:
     """Start one rank per host over ssh, under the supervision loop.
 
@@ -152,9 +172,26 @@ def launch_dist(
     the same loop: the failed attempt tears down, the backoff absorbs
     the blip, the relaunch reconnects; the rendezvous itself also
     retries per rank (parallel/distributed.py). max_restarts=0 is one
-    plain un-supervised attempt."""
+    plain un-supervised attempt.
+
+    ``--allow-shrink`` (degraded-mode supervision, docs/ROBUSTNESS.md
+    "Host lost"): a watchdog dead/missing verdict — no heartbeat across
+    the grace window, the host-UNREACHABLE signature, as opposed to a
+    rank process that exits nonzero on a live host — marks that host
+    lost, and the relaunch runs on the SURVIVING host set with a
+    recomputed XFLOW_NUM_PROCESSES (the first survivor becomes rank
+    0 / the coordinator). The elastic restore reshards the last
+    committed checkpoint into the smaller world and the data pipeline
+    re-assigns the lost host's shard, so the record set stays covered.
+    Before each relaunch the lost hosts are probed (`probe_host`); one
+    that answers again rejoins — the job grows back at that restart's
+    checkpoint-restore boundary."""
     from xflow_tpu.launch.local import resolve_launch_run_id
-    from xflow_tpu.launch.supervise import resume_forward_args, supervise
+    from xflow_tpu.launch.supervise import (
+        DeadHostTracker,
+        resume_forward_args,
+        supervise,
+    )
 
     if forward_args and forward_args[0] == "--":
         forward_args = forward_args[1:]
@@ -163,20 +200,46 @@ def launch_dist(
     # forwarded --set args must join too)
     env_extra = dict(env_extra or {})
     env_extra.setdefault("XFLOW_RUN_ID", resolve_launch_run_id())
+    # the launch's ORIGINAL host count: a shrunk relaunch with no
+    # committed data_state yet still learns the full shard set from
+    # this (see trainer._fit) instead of silently training a subset
+    env_extra.setdefault("XFLOW_ORIG_WORLD", str(len(hosts)))
     if dry_run:
         return _launch_dist_once(
             hosts, forward_args, port=port, ssh_cmd=ssh_cmd, workdir=workdir,
             python=python, env_extra=env_extra, dry_run=True, run_dir=run_dir,
         )
+    tracker = DeadHostTracker(allow_shrink)
 
     def attempt(gen: int) -> int:
+        for lost in sorted(tracker.lost):
+            if probe_host(lost, ssh_cmd=ssh_cmd):
+                print(
+                    f"launch-dist: lost host {lost} answers again; "
+                    f"rejoining the world at generation {gen}",
+                    file=sys.stderr,
+                )
+                tracker.revive(lost)
+        alive = tracker.survivors(hosts) or hosts[:1]
+        if len(alive) < len(hosts):
+            print(
+                f"launch-dist: relaunching generation {gen} DEGRADED on "
+                f"{len(alive)}/{len(hosts)} host(s) (--allow-shrink; "
+                f"lost: {', '.join(sorted(tracker.lost))}); rank 0 = "
+                f"{alive[0]}",
+                file=sys.stderr,
+            )
         args = forward_args if gen == 0 else resume_forward_args(forward_args)
         env_gen = {**env_extra, "XFLOW_RESTART_GEN": str(gen)}
         return _launch_dist_once(
-            hosts, args, port=port, ssh_cmd=ssh_cmd, workdir=workdir,
+            alive, args, port=port, ssh_cmd=ssh_cmd, workdir=workdir,
             python=python, env_extra=env_gen, run_dir=run_dir,
             straggler_factor=straggler_factor, dead_after_s=dead_after_s,
             watchdog_poll_s=watchdog_poll_s, gen=gen,
+            # one-lost-HOST-per-attempt policy (culprit ordering) lives
+            # on the tracker; the verdict names a rank of THIS
+            # attempt's world, mapped back to the host it ran on
+            on_dead_row=tracker.attempt_recorder(labels=alive),
         )
 
     return supervise(
@@ -202,6 +265,7 @@ def _launch_dist_once(
     dead_after_s: float = 0.0,
     watchdog_poll_s: float = 0.0,
     gen: int = 0,
+    on_dead_row=None,
 ) -> int:
     """One attempt: start one rank per host over ssh and wait for all.
 
@@ -255,6 +319,17 @@ def _launch_dist_once(
         # collected files instead.
         from xflow_tpu.launch.watchdog import RunWatchdog
 
+        def on_dead(row):
+            # escalation policy (elastic recovery): the verdict only
+            # SETS a flag here (and feeds the supervisor's dead-host
+            # tracker under --allow-shrink); teardown happens on the
+            # launcher thread's poll loop below, and the supervision
+            # wrapper decides whether — and at what shape — the job
+            # relaunches
+            if on_dead_row is not None:
+                on_dead_row(row)
+            dead_verdict.set()
+
         watchdog = RunWatchdog(
             run_dir,
             num_ranks=len(hosts),
@@ -262,11 +337,7 @@ def _launch_dist_once(
             dead_after_s=dead_after_s,
             poll_s=watchdog_poll_s,
             run_id=env_extra.get("XFLOW_RUN_ID", ""),
-            # escalation policy (elastic recovery): the verdict only
-            # SETS a flag here; teardown happens on the launcher
-            # thread's poll loop below, and the supervision wrapper
-            # decides whether the job relaunches
-            on_dead=lambda row: dead_verdict.set(),
+            on_dead=on_dead,
             gen=gen,
         )
         watchdog.start()

@@ -72,6 +72,8 @@ def _launch_local_once(
     watchdog_poll_s: float = 0.0,
     run_id: str = "",
     gen: int = 0,
+    on_dead_row=None,
+    orig_world: int = 0,
 ) -> int:
     """One attempt: fork the ranks, watch them, return the job's exit
     code. FAIL-FAST like launch-dist: SPMD peers of a dead rank block
@@ -88,9 +90,16 @@ def _launch_local_once(
         # liveness watchdog over the ranks' heartbeat streams: flags
         # dead ranks and stragglers while the run is still going
         # (launch/watchdog.py; <= 0 knobs take the module defaults).
-        # The on_dead policy only SETS a flag — teardown happens on the
-        # launcher thread below, never on the poller thread.
+        # The on_dead policy only SETS a flag (and hands the status row
+        # to the supervisor's dead-host tracker, --allow-shrink) —
+        # teardown happens on the launcher thread below, never on the
+        # poller thread.
         from xflow_tpu.launch.watchdog import RunWatchdog
+
+        def on_dead(row):
+            if on_dead_row is not None:
+                on_dead_row(row)
+            dead_verdict.set()
 
         watchdog = RunWatchdog(
             run_dir,
@@ -99,7 +108,7 @@ def _launch_local_once(
             dead_after_s=dead_after_s,
             poll_s=watchdog_poll_s,
             run_id=run_id,
-            on_dead=lambda row: dead_verdict.set(),
+            on_dead=on_dead,
             gen=gen,
         )
         watchdog.start()
@@ -109,6 +118,11 @@ def _launch_local_once(
         env.update(
             XFLOW_COORDINATOR=coordinator,
             XFLOW_NUM_PROCESSES=str(num_processes),
+            # the launch's ORIGINAL rank count: a shrunk relaunch that
+            # has no committed data_state yet (death before the first
+            # checkpoint) still learns the full shard set from this —
+            # without it the survivors would silently train a subset
+            XFLOW_ORIG_WORLD=str(orig_world or num_processes),
             XFLOW_PROCESS_ID=str(rank),
             XFLOW_RUN_ID=run_id,
             # restart generation: stamped into every JSONL record the
@@ -154,15 +168,26 @@ def launch_local(
     max_restarts: int = 0,
     restart_backoff: float = 1.0,
     min_uptime_s: float = 0.0,
+    allow_shrink: bool = False,
 ) -> int:
     """Run the local cluster under the supervision loop
     (launch/supervise.py): on a nonzero rank exit or a watchdog
     dead-rank verdict the whole job is torn down and — while the
     ``--max-restarts`` budget lasts — relaunched with
     ``train.resume=true`` under the SAME run dir and run id, the
-    restart generation stamped into every record. max_restarts=0 is
+    restart generation stamped into every record. With
+    ``--allow-shrink``, a watchdog dead/missing verdict (the emulated
+    host-loss: a WEDGED rank, vs a dead process that merely exits)
+    relaunches with a SHRUNK world — the surviving rank count, ranks
+    renumbered 0..M-1 — and the elastic restore reshards the
+    checkpoint and re-assigns the data shards so the full record set
+    stays covered (docs/ROBUSTNESS.md "Host lost"). max_restarts=0 is
     one plain un-supervised attempt."""
-    from xflow_tpu.launch.supervise import resume_forward_args, supervise
+    from xflow_tpu.launch.supervise import (
+        DeadHostTracker,
+        resume_forward_args,
+        supervise,
+    )
 
     if forward_args and forward_args[0] == "--":
         forward_args = forward_args[1:]
@@ -170,11 +195,20 @@ def launch_local(
     # metrics/quarantine/heartbeat JSONL streams join on it, and the
     # `gen` stamp keeps the generations apart within it
     run_id = resolve_launch_run_id()
+    tracker = DeadHostTracker(allow_shrink)
 
     def attempt(gen: int) -> int:
+        n = tracker.shrunk_world(num_processes)
+        if n < num_processes:
+            print(
+                f"launch-local: relaunching generation {gen} DEGRADED at "
+                f"{n}/{num_processes} rank(s) (--allow-shrink; "
+                f"{len(tracker.lost)} emulated host(s) lost)",
+                file=sys.stderr,
+            )
         args = forward_args if gen == 0 else resume_forward_args(forward_args)
         return _launch_local_once(
-            num_processes,
+            n,
             args,
             port=port,
             run_dir=run_dir,
@@ -183,6 +217,10 @@ def launch_local(
             watchdog_poll_s=watchdog_poll_s,
             run_id=run_id,
             gen=gen,
+            # one-loss-per-attempt policy (culprit ordering) lives on
+            # the tracker; a local "host" is an emulated process slot
+            on_dead_row=tracker.attempt_recorder(gen=gen),
+            orig_world=num_processes,
         )
 
     return supervise(

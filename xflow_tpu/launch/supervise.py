@@ -47,6 +47,89 @@ BACKOFF_CAP_S = 60.0
 EX_TEMPFAIL = 75
 
 
+class DeadHostTracker:
+    """Dead-HOST bookkeeping for degraded-mode supervision
+    (``--allow-shrink``, docs/ROBUSTNESS.md "Host lost").
+
+    The failure taxonomy the shrink policy rests on: a rank that EXITS
+    nonzero is a dead *process* on a live host — the same host can run
+    the relaunch, so the job restarts same-shape. A watchdog
+    dead/missing VERDICT (no heartbeat across the grace window — the
+    host-unreachable signature, since a merely-crashed process would
+    have exited) is a dead *host*: relaunching the same shape would
+    just re-fail the rendezvous, so with ``--allow-shrink`` the next
+    attempt runs with the SURVIVING set and a recomputed world size.
+    Both launchers feed their watchdog's ``on_dead`` rows through
+    `record` and size the relaunch with `survivors`/`shrunk_world`;
+    launch-dist additionally `revive`s a host its pre-relaunch probe
+    finds reachable again — the grow-back path (the elastic restore
+    reshards the checkpoint either way).
+
+    `record` takes an opaque label — a host string for launch-dist, a
+    per-generation rank tag for launch-local (where a "host" is an
+    emulated process slot and cannot rejoin). Off (`allow_shrink`
+    False) every method is a no-op and the relaunch stays same-shape.
+    """
+
+    def __init__(self, allow_shrink: bool = False):
+        self.allow_shrink = bool(allow_shrink)
+        self.lost: set = set()
+
+    def record(self, label) -> None:
+        if self.allow_shrink:
+            self.lost.add(label)
+
+    def attempt_recorder(self, labels: Optional[list] = None, gen: int = 0):
+        """The watchdog `on_dead` hook for ONE attempt — records ONE
+        loss: once a host wedges, its SPMD peers block in the next
+        collective and stop beating ~2 steps later, so the same
+        watchdog scan flags them too; the culprit ordering (lowest
+        step first) makes the FIRST verdict the host actually lost and
+        the rest its victims. (Under a coarse heartbeat cadence the
+        culprit and its victims can tie on the same beat step; a
+        victim recorded by mistake costs one extra restart — its probe
+        passes and it rejoins — while the true loss gets verdicted
+        again next attempt, so the policy converges.)
+
+        `labels` maps the verdict's rank to a durable label (the
+        attempt's host list, launch-dist); None tags the loss
+        ``(gen, rank)`` (launch-local's emulated slots, where
+        renumbered ranks must not collide across attempts). Malformed
+        or out-of-range ranks are ignored, never recorded."""
+        fired: list = []
+
+        def on_dead(row: dict) -> None:
+            r = row.get("rank")
+            if fired or not isinstance(r, int) or r < 0:
+                return
+            if labels is None:
+                fired.append(row)
+                self.record((gen, r))
+            elif r < len(labels):
+                fired.append(row)
+                self.record(labels[r])
+
+        return on_dead
+
+    def revive(self, label) -> None:
+        self.lost.discard(label)
+
+    def shrunk_world(self, total: int, floor: int = 1) -> int:
+        """World size for the next attempt: the original count minus
+        the lost set, never below `floor` (a job cannot shrink to zero
+        ranks — the last survivor keeps the run alive)."""
+        if not self.allow_shrink:
+            return int(total)
+        return max(int(total) - len(self.lost), int(floor))
+
+    def survivors(self, items: list) -> list:
+        """`items` minus the lost labels, original order preserved
+        (the first survivor becomes rank 0 / the coordinator)."""
+        if not self.allow_shrink:
+            return list(items)
+        return [x for x in items if x not in self.lost]
+
+
 def backoff_delay(
     attempt: int, base_s: float, cap_s: float = BACKOFF_CAP_S, rng=None
 ) -> float:

@@ -70,17 +70,16 @@ def fold_heartbeats(
         ts = rec.get("ts")
         if run_id is not None and rec.get("run_id") != run_id:
             continue
-        if gen is not None:
-            # defensive like rank/ts below: one damaged gen value (a
-            # string, a NaN) must skip one record, not raise and blind
-            # every later watchdog scan
-            g = rec.get("gen", 0)
-            try:
-                g = int(g) if isinstance(g, (int, float)) else None
-            except (ValueError, OverflowError):  # NaN/inf floats
-                g = None
-            if g != gen:
-                continue
+        # defensive like rank/ts below: one damaged gen value (a
+        # string, a NaN) must skip one record (or fold as gen 0 in the
+        # unfiltered view), not raise and blind every later scan
+        g = rec.get("gen", 0)
+        try:
+            g = int(g) if isinstance(g, (int, float)) else None
+        except (ValueError, OverflowError):  # NaN/inf floats
+            g = None
+        if gen is not None and g != gen:
+            continue
         if not isinstance(rank, int) or not isinstance(ts, (int, float)):
             continue
         cur = beats.get(rank)
@@ -90,6 +89,11 @@ def fold_heartbeats(
                 "step": int(step) if isinstance(step, (int, float)) else (cur["step"] if cur else 0),
                 "ts": float(ts),
                 "event": rec.get("event"),
+                # the beat's restart generation rides along for the
+                # offline view: metrics_report --health labels a rank
+                # whose beats STOP at an old generation of a shrunk run
+                # as retired@genK, not dead
+                "gen": g if g is not None else 0,
             }
     return beats
 
@@ -224,12 +228,16 @@ class RunWatchdog:
         self._events = JsonlAppender(
             os.path.join(run_dir, "watchdog.jsonl"),
             # rank -1 = the launcher itself; kind separates the stream;
-            # gen passed explicitly (see class docstring)
+            # gen AND world passed explicitly — the launcher process
+            # owns the generation and its (possibly shrunk) rank count;
+            # its own env has neither XFLOW_RESTART_GEN nor
+            # XFLOW_NUM_PROCESSES
             stamp={
                 "rank": -1,
                 "run_id": run_id or "?",
                 "kind": "watchdog",
                 "gen": int(gen),
+                "world": int(num_ranks),
             },
         )
         self._stop = threading.Event()

@@ -99,6 +99,30 @@ def resolve_rank() -> int:
         return 0
 
 
+def resolve_world_size() -> int:
+    """The launch world size for record stamping (`world` in every
+    JSONL record). Under degraded-mode supervision (--allow-shrink,
+    docs/ROBUSTNESS.md) a relaunch after a lost host runs with FEWER
+    ranks under the same run_id — the per-generation world stamp is how
+    report tools tell a shrunk-away rank (`retired@genK`) from a dead
+    one. The launcher env (XFLOW_NUM_PROCESSES) is authoritative, same
+    pattern as resolve_rank; falls back to jax.process_count()."""
+    env = os.environ.get("XFLOW_NUM_PROCESSES")
+    if env:
+        try:
+            n = int(env)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
 # ------------------------------------------------------------------ registry
 
 

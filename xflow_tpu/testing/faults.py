@@ -71,6 +71,66 @@ def bitflip_file(path: str, offset: Optional[int] = None, count: int = 8,
     return offsets
 
 
+def bitflip_npz_array(path: str, member: Optional[str] = None, count: int = 8,
+                      seed: int = 0, offset: Optional[int] = None) -> list[int]:
+    """Flip bits inside ONE array member's payload of an .npz and
+    REWRITE the container with fresh zip CRCs — SILENT corruption by
+    construction: a raw `bitflip_file` on an npz trips the zip layer's
+    own CRC32 on read (the loud failure mode `restore_any` already
+    heals), while this flip survives every container-level check and is
+    caught only by the per-array digests meta.json records at save
+    (checkpoint v3, `verify_digest`). The .npy header is skipped too —
+    a damaged header fails loudly at parse, which is not the drill.
+
+    `member` defaults to the largest array (the table payload).
+    `offset` pins the first flipped byte, RELATIVE to the array payload
+    (offset 0 = the first data byte after the header); out-of-payload
+    offsets raise ValueError rather than silently invalidating the
+    drill. Returns the flipped offsets within the member's bytes."""
+    import struct
+    import zipfile
+
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        target = member or max(names, key=lambda n: z.getinfo(n).file_size)
+        blobs = {n: z.read(n) for n in names}
+    data = bytearray(blobs[target])
+    # .npy layout: \x93NUMPY, major, minor, header-len (2 bytes v1.x /
+    # 4 bytes v2.x), header, then raw array bytes — flip only past the
+    # header so dtype/shape parse fine and the VALUES are what changed
+    if len(data) < 12 or data[:6] != b"\x93NUMPY":
+        raise ValueError(f"{target!r} in {path!r} is not an .npy member")
+    if data[6] >= 2:
+        start = 12 + struct.unpack("<I", data[8:12])[0]
+    else:
+        start = 10 + struct.unpack("<H", data[8:10])[0]
+    if start >= len(data):
+        raise ValueError(f"{target!r} has no array payload to corrupt")
+    first = None
+    if offset is not None:
+        first = start + int(offset)
+        if not start <= first < len(data):
+            raise ValueError(
+                f"offset {offset} is outside {target!r}'s array payload "
+                f"(0..{len(data) - start - 1})"
+            )
+    rng = random.Random(seed)
+    offsets = sorted(
+        {first if first is not None and i == 0 else rng.randrange(start, len(data))
+         for i in range(count)}
+    )
+    for off in offsets:
+        data[off] ^= 1 << rng.randrange(8)
+    blobs[target] = bytes(data)
+    # rewrite uncompressed (np.savez's own layout): the zip member CRCs
+    # are recomputed over the CORRUPTED bytes, so the container stays
+    # self-consistent and only the digest layer can tell
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
+        for n in names:
+            z.writestr(n, blobs[n])
+    return offsets
+
+
 # ------------------------------------------------------ checkpoint corruption
 def _apply(path: str, mode: str, **kw) -> str:
     if mode == "truncate":
@@ -92,7 +152,12 @@ def corrupt_npz_checkpoint(ckpt_dir: str, step: Optional[int] = None,
     LOOKS valid and fails only when read.
 
     target="state" (default): `state.npz` — the case restore_any heals
-    by walking back to the previous committed step.
+    by walking back to the previous committed step. mode="bitflip"
+    there flips bytes INSIDE an array payload and rewrites the
+    container (`bitflip_npz_array`): the zip CRCs stay self-consistent,
+    so only the v3 per-array digests catch it — the silent-corruption
+    drill. (mode="truncate", and raw flips via the CLI's --file, stay
+    the loud container-level failure modes.)
     target="data_state": `data_state.json` (elastic recovery) — the
     case read_data_state DOWNGRADES: the model still restores, the run
     resumes with a fresh stream, and the downgrade is logged. Operators
@@ -113,6 +178,13 @@ def corrupt_npz_checkpoint(ckpt_dir: str, step: Optional[int] = None,
             )
     elif target == "state":
         victim = os.path.join(ckpt_dir, f"step_{step}", "state.npz")
+        if mode == "bitflip":
+            bitflip_npz_array(
+                victim,
+                **{k: v for k, v in kw.items()
+                   if k in ("member", "offset", "count", "seed")},
+            )
+            return victim
     else:
         raise ValueError(f"target={target!r}: expected state|data_state")
     return _apply(victim, mode, **kw)

@@ -20,6 +20,7 @@ import os
 import re
 import shutil
 import sys
+import zlib
 from typing import Optional
 
 import jax
@@ -32,11 +33,33 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 # checkpoint metadata version (meta.json "version"):
 #   (absent) — pre-elastic-recovery checkpoints: model state only
 #   2 — adds the host-side data_state.json (exact data-pipeline resume)
+#   3 — topology-elastic + integrity-verified (docs/DISTRIBUTED.md
+#       "Canonical checkpoint layout"): meta carries the LOGICAL layout
+#       ({array: shape}), the writer's world_size, and per-array
+#       digests ("crc32:%08x" over the stored bytes) so a silently
+#       bit-flipped shard fails the restore LOUDLY and restore_any
+#       walks back to the previous committed step; data_state gains
+#       per-SHARD batch offsets so a run checkpointed at N ranks
+#       resumes at M ranks with exact record-set coverage.
 # Readers NEVER require the new pieces: a version-less checkpoint (or a
 # v2 one whose data_state was lost/truncated) restores the model and
-# resumes with a fresh stream, logging the downgrade (read_data_state).
-CHECKPOINT_VERSION = 2
+# resumes with a fresh stream, logging the downgrade (read_data_state);
+# a v2 data_state folds into the topology-independent v3 view
+# (normalize_data_state).
+CHECKPOINT_VERSION = 3
+# data_state.json "version": 1 = per-rank counters (PR 4); 2 = the
+# topology-independent form (global examples, per-shard offsets)
+DATA_STATE_VERSION = 2
 DATA_STATE_FILE = "data_state.json"
+
+
+class CheckpointDigestError(RuntimeError):
+    """A stored array's bytes no longer match the digest recorded in
+    meta.json at save time — silent media/transfer corruption (the zip
+    layer catches raw npz flips, but a rewritten container or an OCDBT
+    data file has no such net). Raised from the restore paths so
+    restore_any turns the corruption into a logged walk-back to the
+    previous committed step, never a restore of corrupted state."""
 
 
 def data_state_path(ckpt_dir: str, step: int, fmt: str = "npz") -> str:
@@ -79,6 +102,119 @@ def read_data_state(ckpt_dir: str, step: int, fmt: str = "npz") -> Optional[dict
         )
         return None
     return ds
+
+
+def normalize_data_state(ds: dict) -> dict:
+    """Fold any stored data_state version into the canonical
+    topology-independent v2 shape the elastic resume consumes:
+
+    - ``examples``: GLOBAL total across ranks (v1 multi-process records
+      keyed examples per rank; they fold by summation — a logged
+      downgrade of precision never a failure),
+    - ``shard_batches``: {shard index -> batches consumed within the
+      epoch}. v1 records carry only the global coordinated offset, but
+      v1 runs consumed their shards in LOCKSTEP (one shard per rank,
+      coordinated steps), so every shard's consumed prefix IS that
+      offset — the fold is exact, not approximate.
+    - ``num_shards`` / ``world_size``: the shard set in play and the
+      writer's rank count (v1: both = len(examples_per_rank), or 1).
+
+    Raises TypeError/ValueError on malformed input — callers downgrade
+    to a fresh stream (trainer._consume_resume_position)."""
+    out = {
+        "version": DATA_STATE_VERSION,
+        "epoch": max(int(ds.get("epoch", 0)), 0),
+        "batches": max(int(ds.get("batches", 0)), 0),
+        "completed": bool(ds.get("completed", False)),
+        "examples": max(int(ds.get("examples", 0)), 0),
+        "quarantined_rows": max(int(ds.get("quarantined_rows", 0)), 0),
+    }
+    sb = ds.get("shard_batches")
+    if isinstance(sb, dict):
+        out["shard_batches"] = {
+            int(k): max(int(v), 0) for k, v in sb.items()
+        }
+        out["num_shards"] = max(
+            int(ds.get("num_shards", 0)),
+            max(out["shard_batches"], default=-1) + 1,
+            1,
+        )
+        out["world_size"] = max(int(ds.get("world_size", 1)), 1)
+        return out
+    # v1 (meta v2 era): per-rank-keyed record — fold into the global view
+    per_rank = ds.get("examples_per_rank")
+    n = len(per_rank) if isinstance(per_rank, list) and per_rank else 1
+    out["world_size"] = n
+    out["num_shards"] = n
+    out["shard_batches"] = {i: out["batches"] for i in range(n)}
+    if isinstance(per_rank, list) and per_rank:
+        out["examples"] = sum(max(int(x), 0) for x in per_rank)
+    if out["epoch"] or out["batches"]:
+        print(
+            f"# checkpoint: v1 data_state (per-rank keyed, {n} rank(s)) "
+            "folded into the topology-independent form: global examples "
+            f"{out['examples']}, per-shard offset {out['batches']}",
+            file=sys.stderr,
+        )
+    return out
+
+
+# ------------------------------------------------------------- integrity
+def array_digest(arr: np.ndarray) -> str:
+    """Digest of an array's raw bytes, written into meta.json at save
+    and verified on restore. crc32 (stdlib, streams at GB/s — noise
+    against the host gather the npz save already does) is enough to
+    catch every single-bit and most multi-byte flips; the format tag
+    leaves room for a stronger hash later without a version bump."""
+    arr = np.ascontiguousarray(arr)
+    return "crc32:%08x" % (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF)
+
+
+def verify_digest(label: str, arr: np.ndarray, digests: Optional[dict], source: str) -> None:
+    """Raise CheckpointDigestError when `arr` no longer matches the
+    digest meta.json recorded for `label`; arrays the meta never
+    digested (pre-v3 checkpoints, multi-process orbax saves) pass."""
+    if not digests:
+        return
+    want = digests.get(label)
+    if not want:
+        return
+    got = array_digest(np.asarray(arr))
+    if got != want:
+        raise CheckpointDigestError(
+            f"checkpoint {source!r}: array {label!r} digest mismatch "
+            f"(stored {want}, read {got}) — silent shard corruption; "
+            "walking back to the previous committed step"
+        )
+
+
+def read_meta(ckpt_dir: str, step: int, fmt: str = "npz") -> Optional[dict]:
+    """meta.json of checkpoint `step` (the orbax format keeps it as an
+    `orbax_step_N.meta.json` sibling, like its data_state), or None —
+    with a logged note — when missing/unreadable: a pre-v3 checkpoint
+    simply restores without digest verification, never fails on it."""
+    if fmt == "orbax":
+        path = os.path.join(ckpt_dir, f"orbax_step_{step}.meta.json")
+    else:
+        path = os.path.join(ckpt_dir, f"step_{step}", "meta.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+        if not isinstance(meta, dict):
+            raise ValueError(f"expected a JSON object, got {type(meta).__name__}")
+    except Exception as e:  # noqa: BLE001 — unreadable meta downgrades
+        # to an unverified restore (the state itself may be fine); a
+        # CORRUPT state still fails through the digest-less load path
+        print(
+            f"# checkpoint: step {step} meta unreadable "
+            f"({type(e).__name__}: {e}); restoring without digest "
+            "verification",
+            file=sys.stderr,
+        )
+        return None
+    return meta
 
 
 def _to_host(arr) -> np.ndarray:
@@ -182,11 +318,19 @@ def save(
                 np.savez(f, **flat)
 
         _write_atomic(os.path.join(path, "state.npz"), write_npz)
+        # v3 metadata: the canonical LOGICAL layout (npz always stores
+        # [S, K], _unpack_host), the writer's world size (informational
+        # — restore reshards into whatever mesh is live), and per-array
+        # digests over exactly the bytes a reader gets back, so a
+        # silent flip fails the restore instead of training garbage
         meta = {
             "step": step,
             "tables": sorted(state.tables),
             "format": "npz",
             "version": CHECKPOINT_VERSION,
+            "world_size": jax.process_count(),
+            "layout": {k: list(np.asarray(v).shape) for k, v in flat.items()},
+            "digests": {k: array_digest(v) for k, v in flat.items()},
         }
 
         def write_json(p):
@@ -248,11 +392,16 @@ def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
         steps = orbax_steps(ckpt_dir)
         doomed = []
         for s in steps[keep:] if keep > 0 else []:
-            # a pruned orbax step takes its sibling data_state file with
-            # it — an orphaned data_state would pair with the WRONG
-            # stream position if that step number ever recurs
+            # a pruned orbax step takes its sibling data_state AND meta
+            # files with it — an orphaned sibling would pair with the
+            # WRONG stream position / digests if that step number ever
+            # recurs
             doomed.extend(
-                [f"orbax_step_{s}", os.path.basename(data_state_path(ckpt_dir, s, "orbax"))]
+                [
+                    f"orbax_step_{s}",
+                    os.path.basename(data_state_path(ckpt_dir, s, "orbax")),
+                    f"orbax_step_{s}.meta.json",
+                ]
             )
         # stale-debris sweep, orbax flavor: a save killed mid-write leaves
         # orbax's own temp dir (`orbax_step_N.orbax-checkpoint-tmp-...`),
@@ -283,15 +432,20 @@ def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
     return removed
 
 
-def restore_any(ckpt_dir: str, like: TrainState, fmt: str = "npz"):
+def restore_any(ckpt_dir: str, like: TrainState, fmt: str = "npz", verify: str = "auto"):
     """Self-healing restore: walk back from the newest committed step.
 
     Returns (state, step). A checkpoint that fails to load — truncated
-    npz, bit-flipped orbax shard, unreadable metadata — is logged with
-    the reason and SKIPPED, and the previous committed step is tried,
-    instead of one corrupt file killing a resumable run. Raises
-    FileNotFoundError when no checkpoint exists at all, RuntimeError
-    (listing every failure) when none of the existing ones loads."""
+    npz, bit-flipped orbax shard, a digest mismatch against the meta
+    written at save (CheckpointDigestError — the SILENT-corruption
+    case no container-level check catches), unreadable metadata — is
+    logged with the reason and SKIPPED, and the previous committed step
+    is tried, instead of one corrupt file killing a resumable run (or,
+    worse, restoring garbage). Raises FileNotFoundError when no
+    checkpoint exists at all, RuntimeError (listing every failure) when
+    none of the existing ones loads. `verify` is the digest policy
+    (train.checkpoint_verify): "auto" verifies whenever digests exist
+    and the arrays are host-visible; "off" skips."""
     steps = orbax_steps(ckpt_dir) if fmt == "orbax" else committed_steps(ckpt_dir)
     if not steps:
         raise FileNotFoundError(
@@ -302,9 +456,9 @@ def restore_any(ckpt_dir: str, like: TrainState, fmt: str = "npz"):
     for step in steps:
         try:
             if fmt == "orbax":
-                state = restore_orbax(ckpt_dir, like, step=step)
+                state = restore_orbax(ckpt_dir, like, step=step, verify=verify)
             else:
-                state = restore(ckpt_dir, like, step=step)
+                state = restore(ckpt_dir, like, step=step, verify=verify)
         except Exception as e:  # noqa: BLE001 — every failure mode of a
             # corrupt file (BadZipFile, zlib.error, OSError, orbax/
             # tensorstore errors, shape mismatches) must take the
@@ -426,17 +580,44 @@ def _put_migrated(label: str, arr, template, stored_tables, source: str):
     return jnp.asarray(arr)
 
 
-def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> TrainState:
-    """Restore into the sharding/structure of `like` (device_put per leaf)."""
+def restore(
+    ckpt_dir: str,
+    like: TrainState,
+    step: Optional[int] = None,
+    verify: str = "auto",
+) -> TrainState:
+    """Restore into the sharding/structure of `like` (device_put per
+    leaf). Topology-agnostic by construction: the npz stores the full
+    LOGICAL arrays, so a checkpoint written at N ranks restores into
+    any M-rank mesh — each leaf is placed onto `like`'s live sharding,
+    whatever engine (single-device, GSPMD, sorted replicated,
+    fullshard) built it. With `verify` != "off", every stored array
+    read is checked against the digest meta.json recorded at save; a
+    mismatch raises CheckpointDigestError (restore_any walks back)."""
     step = latest_step(ckpt_dir) if step is None else step
     if step is None:
         raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir}")
     path = os.path.join(ckpt_dir, f"step_{step}")
     data = np.load(os.path.join(path, "state.npz"))
     stored_tables = sorted(k.split("/", 1)[1] for k in data.files if k.startswith("tables/"))
+    meta = read_meta(ckpt_dir, step) if verify != "off" else None
+    digests = meta.get("digests") if isinstance(meta, dict) else None
+    verified: set = set()
+
+    def stored(name: str):
+        """Read one stored array, digest-verified exactly once (the
+        fused-alias bridge reads arrays under OTHER names; routing every
+        read through here keeps the verification complete)."""
+        if name not in data:
+            return None
+        arr = data[name]
+        if name not in verified:
+            verified.add(name)
+            verify_digest(name, arr, digests, path)
+        return arr
 
     def put(name: str, template):
-        arr = data[name] if name in data else None
+        arr = stored(name)
         if arr is None:
             # fm_fused layout bridge: the key path keeps its group/sub
             # ("tables/w" <- "tables/wv"; "opt/w/n" <- "opt/wv/n")
@@ -444,9 +625,7 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
             parts = rest.split("/")
             sub = "/" + parts[1] if len(parts) > 1 else ""
             arr = _fused_alias(
-                lambda t: data[f"{group}/{t}{sub}"]
-                if f"{group}/{t}{sub}" in data
-                else None,
+                lambda t: stored(f"{group}/{t}{sub}"),
                 parts[0],
                 like,
             )
@@ -459,7 +638,7 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
     }
     import jax.numpy as jnp
 
-    return TrainState(tables=tables, opt_state=opt_state, step=jnp.asarray(data["step"]))
+    return TrainState(tables=tables, opt_state=opt_state, step=jnp.asarray(stored("step")))
 
 
 # --------------------------------------------------------------- orbax format
@@ -470,6 +649,21 @@ def restore(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> Trai
 # directly (OCDBT), so no host ever materializes the full table, and
 # restore places shards straight onto the target sharding.
 
+def _flatten_native(tree: dict) -> dict:
+    """{label: leaf} over an orbax state tree in its NATIVE (device)
+    layout — the ONE place the `tables/<n>` / `opt_state/<n>/<k>` key
+    naming lives: the digest writer (save_orbax) and verifier
+    (_verify_orbax_digests) must agree byte-for-byte or every digest
+    lookup silently misses and verification becomes a no-op."""
+    flat = {}
+    for n, t in tree.get("tables", {}).items():
+        flat[f"tables/{n}"] = t
+    for n, st in tree.get("opt_state", {}).items():
+        for k, v in st.items():
+            flat[f"opt_state/{n}/{k}"] = v
+    return flat
+
+
 def save_orbax(
     ckpt_dir: str, state: TrainState, data_state: Optional[dict] = None
 ) -> str:
@@ -479,6 +673,37 @@ def save_orbax(
     path = os.path.abspath(os.path.join(ckpt_dir, f"orbax_step_{step}"))
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state._asdict(), force=True)
+    if jax.process_index() == 0:
+        # v3 meta sibling (same commit protocol as the data_state
+        # sibling: written AFTER orbax's rename-commit, its absence is
+        # just an unverified restore). Digests cover the NATIVE stored
+        # layout and are computed only when every leaf is addressable
+        # on this host (single-process): OCDBT data reads are NOT
+        # checksum-verified (testing/faults.py, measured), so this is
+        # the only net under a bit-flipped shard — but gathering a
+        # 1B-feature state to hash it would defeat the shard-parallel
+        # save, so pod-scale multi-process saves record layout only.
+        flat = _flatten_native(state._asdict())
+        meta = {
+            "step": step,
+            "tables": sorted(state.tables),
+            "format": "orbax",
+            "version": CHECKPOINT_VERSION,
+            "world_size": jax.process_count(),
+            "layout": {k: list(v.shape) for k, v in flat.items()},
+        }
+        if jax.process_count() == 1:
+            meta["digests"] = {
+                k: array_digest(np.asarray(v)) for k, v in flat.items()
+            }
+
+        def write_meta(p):
+            with open(p, "w") as f:
+                json.dump(meta, f)
+
+        _write_atomic(
+            os.path.join(ckpt_dir, f"orbax_step_{step}.meta.json"), write_meta
+        )
     if data_state is not None and jax.process_index() == 0:
         # sibling file, written AFTER orbax finalizes its rename-commit:
         # its presence implies a committed checkpoint, its absence (an
@@ -562,7 +787,30 @@ def _orbax_stored_shapes(path: str) -> Optional[dict]:
     return flat
 
 
-def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -> TrainState:
+def _verify_orbax_digests(tree: dict, digests: Optional[dict], source: str) -> None:
+    """Check a restored orbax pytree against the meta sibling's digests
+    (single-process saves record them; see save_orbax). Skipped with a
+    logged note when a leaf is not fully addressable — a pod-scale
+    restore cannot re-gather the state just to hash it."""
+    if not digests:
+        return
+    for label, leaf in _flatten_native(tree).items():
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            print(
+                "# checkpoint: digest verification skipped (state not "
+                "fully addressable on this host)",
+                file=sys.stderr,
+            )
+            return
+        verify_digest(label, np.asarray(leaf), digests, source)
+
+
+def restore_orbax(
+    ckpt_dir: str,
+    like: TrainState,
+    step: Optional[int] = None,
+    verify: str = "auto",
+) -> TrainState:
     """Restore with `like`'s shardings (shards load directly per process).
 
     Layout migration: orbax stores the NATIVE (possibly packed [S/p, p*K])
@@ -583,6 +831,8 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
     if step is None:
         raise FileNotFoundError(f"no orbax checkpoint under {ckpt_dir}")
     path = os.path.abspath(os.path.join(ckpt_dir, f"orbax_step_{step}"))
+    meta = read_meta(ckpt_dir, step, fmt="orbax") if verify != "off" else None
+    digests = meta.get("digests") if isinstance(meta, dict) else None
 
     like_tree = like._asdict()
     expected = {}
@@ -618,6 +868,10 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
                     "model.fm_fused=false to restore it."
                 ) from e
             raise
+        # fast path = stored shapes equal like's, so the restored leaves
+        # are byte-comparable against the digests taken at save (OCDBT
+        # itself never checksums its data reads — this is the only net)
+        _verify_orbax_digests(restored, digests, path)
         return TrainState(**restored)
 
     # stored layout differs: host-side migration restore
@@ -625,6 +879,9 @@ def restore_orbax(ckpt_dir: str, like: TrainState, step: Optional[int] = None) -
 
     with ocp.StandardCheckpointer() as ckptr:
         stored = ckptr.restore(path)  # host numpy, stored shapes
+    # migration restores the NATIVE stored layout host-side — exactly
+    # the bytes the digests were taken over; verify BEFORE migrating
+    _verify_orbax_digests(stored, digests, path)
     stored_tables = sorted(stored.get("tables", {}))
 
     def put(label: str, arr, lookup, tbl, template):

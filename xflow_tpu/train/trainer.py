@@ -22,9 +22,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from xflow_tpu.config import Config
-from xflow_tpu.data.libffm import shard_path
 from xflow_tpu.jsonl import JsonlAppender
-from xflow_tpu.data.pipeline import batch_iterator, count_batches, prefetch
+from xflow_tpu.data.pipeline import (
+    assign_shards,
+    batch_iterator,
+    count_batches,
+    prefetch,
+)
 from xflow_tpu.metrics import auc_logloss
 from xflow_tpu.models import get_model
 from xflow_tpu.telemetry import (
@@ -331,12 +335,21 @@ class Trainer:
             stamp={"rank": self.rank, "run_id": self.run_id, "kind": "heartbeat"},
         )
         # data-stream position for exact resume (elastic recovery,
-        # docs/ROBUSTNESS.md): (epoch, batches consumed within it),
+        # docs/ROBUSTNESS.md): (epoch, batches consumed within it) plus
+        # the TOPOLOGY-INDEPENDENT truth — per-SHARD consumed-batch
+        # counts (_shard_pos) and the shard set in play (_num_shards) —
         # maintained by the fit loop and snapshotted into every
-        # checkpoint's data_state; _resume_data_state holds what
-        # maybe_restore read back, consumed by the next fit()
+        # checkpoint's data_state, so a run checkpointed at N ranks
+        # resumes at M ranks with exact record-set coverage.
+        # _examples_seen counts THIS process's rows this generation;
+        # _examples_base carries the restored GLOBAL total forward.
+        # _resume_data_state holds what maybe_restore read back,
+        # consumed by the next fit().
         self._epoch_pos = (0, 0)
+        self._shard_pos: dict = {}
+        self._num_shards = 0
         self._examples_seen = 0
+        self._examples_base = 0
         self._resume_data_state: Optional[dict] = None
         # validate the guard mode at CONSTRUCTION (identical config on
         # every rank → rank-symmetric), not on the first bad batch
@@ -621,29 +634,37 @@ class Trainer:
             row_mask=np.zeros((B,), np.float32),
         )
 
-    def _global_batch_count(self, path: str, skip: int = 0) -> tuple[int, int]:
-        """(global_steps, local_batches) for one pass over `path`,
-        with the first `skip` batches fast-forwarded (data_state
-        resume; `skip` is the GLOBAL within-epoch offset, identical on
-        every rank, so the subtraction is rank-symmetric).
+    def _epoch_batch_count(
+        self, shards: list, skips: dict
+    ) -> tuple[int, int]:
+        """(global_steps, local_batches) for one pass over this rank's
+        assigned `shards` ([(shard index, path)]), with each shard's
+        stored `skips` offset fast-forwarded (data_state resume; the
+        skip map comes from the checkpoint so it is identical on every
+        rank, and each rank subtracts only its OWN shards' offsets —
+        rank-symmetric by construction).
 
         SPMD steps are collective: if process A has 10 batches and process
         B has 9 (ragged shards — the reference tolerates this because its
         async workers never synchronize), B would deadlock A. Instead of
         a per-step host allgather (which dominates at µs-scale step times,
         round-1 weak #5), each process counts its local batches with the
-        parser-matched row counter, and ONE allgather per (path, pass)
+        parser-matched row counter, and ONE allgather per epoch pass
         fixes the global step count = max over processes. Re-counted every
         pass (not cached) so shards that appear, grow, or shrink between
-        epochs are picked up. A missing local shard counts as 0 batches
+        epochs are picked up. A missing shard counts as 0 batches
         (reference: rank k simply finds no `<prefix>-%05d` file and its
         workers idle).
         """
-        try:
-            local = count_batches(path, self.cfg.data)
-        except FileNotFoundError:
-            local = 0
-        local = max(local - max(int(skip), 0), 0)
+        local = 0
+        for idx, path in shards:
+            try:
+                n = count_batches(path, self.cfg.data)
+            except FileNotFoundError:
+                n = 0
+            local += max(n - max(int(skips.get(idx, 0)), 0), 0)
+        if jax.process_count() == 1:
+            return local, local
         from jax.experimental import multihost_utils
 
         counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
@@ -662,28 +683,42 @@ class Trainer:
 
     def _coordinated_batches(
         self,
-        path: str,
+        path: "str | list",
         with_plan: bool = True,
         enforce_bad_rows: bool = True,
         quarantine: bool = True,
         track_health: bool = True,
         skip: int = 0,
+        skips: Optional[dict] = None,
     ):
         """Yield exactly the globally-agreed number of (batch, arrays)
-        pairs for `path`, padding with fully-masked empty batches once
-        local input is exhausted. One counting allgather per (path,
-        pass) — re-counted every pass so shards that appear, grow, or
-        shrink between epochs are picked up (`_global_batch_count`);
-        the batch stream itself adds no host collectives (the fullshard
+        pairs for this rank's shard stream, padding with fully-masked
+        empty batches once local input is exhausted.
+
+        `path` is a single file (legacy single-shard contract, shard
+        index = this rank) or a [(shard index, path)] assignment
+        (`data/pipeline.assign_shards` — an elastic world where one
+        rank may own several shards of the original record set); shards
+        are streamed sequentially. One counting allgather per epoch
+        pass — re-counted every pass so shards that appear, grow, or
+        shrink between epochs are picked up (`_epoch_batch_count`); the
+        batch stream itself adds no host collectives (the fullshard
         overflow flag, when that engine is on, is the fit loop's, not
         this iterator's). `with_plan` false skips sorted-plan building
         (mesh eval runs row-major); `enforce_bad_rows`/`quarantine`
         thread through to the bad-record monitor (eval passes count but
-        never raise; only the first training pass quarantines). `skip`
-        fast-forwards the stream past its first `skip` batches
-        (checkpointed data_state resume, data/pipeline.skip_batches) —
-        the skipped prefix is neither planned, monitored, nor counted
-        toward this pass's coordinated step total."""
+        never raise; only the first training pass quarantines).
+        `skips` ({shard index -> batches}, or the legacy scalar `skip`)
+        fast-forwards each shard past its stored offset (checkpointed
+        data_state resume, data/pipeline.skip_batches) — the skipped
+        prefix is neither planned, monitored, nor counted toward this
+        pass's coordinated step total. Every REAL pair's arrays carry a
+        `_shard` marker (popped by the consuming loop before the device
+        transfer) so the fit loop can maintain the per-shard position
+        the next checkpoint's data_state pins; padding pairs carry
+        none."""
+        shards = [(self.rank, path)] if isinstance(path, str) else list(path)
+        skips = dict(skips) if skips else {idx: skip for idx, _ in shards}
 
         prepare = lambda b: self._with_arrays(
             b, with_plan=with_plan, track_health=track_health
@@ -694,20 +729,31 @@ class Trainer:
             # abandonment path close()s it, which cascades into
             # batch_iterator's finally — native parser handles and the
             # quarantine file release promptly, not at some later GC
-            for b in batch_iterator(
-                path, self.cfg.data,
-                enforce_bad_rows=enforce_bad_rows, quarantine=quarantine,
-                skip=skip,
-            ):
-                yield prepare(b)
+            for idx, p in shards:
+                if not os.path.exists(p):
+                    continue  # ragged/elastic worlds: a missing shard idles
+                for b in batch_iterator(
+                    p, self.cfg.data,
+                    enforce_bad_rows=enforce_bad_rows, quarantine=quarantine,
+                    skip=max(int(skips.get(idx, 0)), 0),
+                ):
+                    bb, arrays = prepare(b)
+                    arrays["_shard"] = idx
+                    yield bb, arrays
 
         if jax.process_count() == 1:
+            if not any(os.path.exists(p) for _, p in shards):
+                # legacy loudness: a single process with NO input at all
+                # is a user error, not an idle elastic rank
+                raise FileNotFoundError(shards[0][1] if shards else "<no shards>")
             yield from prefetch(feed())
             return
-        global_steps, local = self._global_batch_count(path, skip=skip)
-        # open the real iterator whenever the file exists (even if counted
-        # 0) so the drift check below can catch a counter that under-reads
-        it = iter(prefetch(feed())) if os.path.exists(path) else iter(())
+        global_steps, local = self._epoch_batch_count(shards, skips)
+        # open the real iterator whenever any shard exists (even if
+        # counted 0) so the drift check below can catch a counter that
+        # under-reads
+        have_any = any(os.path.exists(p) for _, p in shards)
+        it = iter(prefetch(feed())) if have_any else iter(())
         produced = 0
         for _ in range(global_steps):
             pair = next(it, None)
@@ -720,10 +766,11 @@ class Trainer:
         # silently dropped (under-count) or phantom empty steps run
         # (over-count) — either means the counter/parser predicates split
         if next(it, None) is not None or produced != local:
+            names = ", ".join(repr(p) for _, p in shards)
             raise RuntimeError(
-                f"batch count drift on {path!r}: counted {local}, parser "
+                f"batch count drift on {names}: counted {local}, parser "
                 f"produced {produced}{'+' if produced == local else ''} — "
-                "the file changed while this pass was reading it, or the "
+                "a file changed while this pass was reading it, or the "
                 "row-counter and parser predicates disagree (bug)"
             )
 
@@ -786,7 +833,6 @@ class Trainer:
 
     def _fit(self, train_path: Optional[str] = None) -> TrainResult:
         cfg = self.cfg
-        path = train_path or shard_path(cfg.data.train_path, self.rank)
         res = TrainResult()
         # perf_counter for every DURATION (monotonic — wall clock jumps
         # under NTP slew); the records' `ts` field (JsonlAppender) is the
@@ -891,23 +937,69 @@ class Trainer:
 
         # exact data resume (elastic recovery, docs/ROBUSTNESS.md): a
         # restored checkpoint's data_state pins the stream position the
-        # run stopped at; this fit continues there instead of replaying
+        # run stopped at — PER SHARD, so the position survives a
+        # topology change; this fit continues there instead of replaying
         # already-trained records from row 0
-        start_epoch, resume_skip = self._consume_resume_position()
-        self._epoch_pos = (start_epoch, resume_skip)
+        start_epoch, resume_skips = self._consume_resume_position()
+        world = jax.process_count()
+        # the shard set in play: a fresh run covers exactly one shard
+        # per rank (the legacy contract, unchanged); an elastic resume
+        # covers the ORIGINAL record set round-robin over the CURRENT
+        # world (assign_shards), so a run checkpointed at N ranks keeps
+        # training every shard at M ranks. TWO carriers of the original
+        # set size: the checkpoint data_state (num_shards, consumed in
+        # _consume_resume_position) AND the supervisor's XFLOW_ORIG_WORLD
+        # env (the launch's original rank count) — the env covers the
+        # shrink-before-first-checkpoint window and completed-checkpoint
+        # continuation, where there is no (usable) data_state to carry it
+        try:
+            orig_world = int(os.environ.get("XFLOW_ORIG_WORLD", 0) or 0)
+        except ValueError:
+            orig_world = 0
+        self._num_shards = max(self._num_shards, world, orig_world)
+        if train_path:
+            epoch_shards = [(self.rank, train_path)]
+        else:
+            epoch_shards = assign_shards(
+                cfg.data.train_path, self.rank, world, self._num_shards
+            )
+        # a RESUMED shard (nonzero stored offset — the previous world
+        # was mid-way through it) whose file this host cannot see is
+        # DATA LOSS, not the benign ragged-shard idle: per-host shard
+        # files do not follow a lost host's reassignment — say so
+        # loudly (elastic shrink wants a shared filesystem)
+        for idx, p in epoch_shards:
+            if resume_skips.get(idx, 0) > 0 and not os.path.exists(p):
+                print(
+                    f"xflow: warning: resumed shard {idx} ({p!r}) is "
+                    "missing from this host — its remaining records "
+                    "will NOT be trained (per-host shard files are not "
+                    "visible to the surviving ranks; keep shards on a "
+                    "shared filesystem for elastic shrink)",
+                    file=sys.stderr,
+                )
+        self._epoch_pos = (start_epoch, max(resume_skips.values(), default=0))
         stop_sig = 0
         try:
             for epoch in range(start_epoch, cfg.train.epochs):
-                # the resume offset applies to the FIRST (partially
+                # the resume offsets apply to the FIRST (partially
                 # consumed) epoch only; later epochs read from row 0
-                skip = resume_skip if epoch == start_epoch else 0
-                steps_in_epoch = skip
+                skips = resume_skips if epoch == start_epoch else {}
+                self._shard_pos = {
+                    idx: max(int(skips.get(idx, 0)), 0) for idx, _ in epoch_shards
+                }
+                steps_in_epoch = max(self._shard_pos.values(), default=0)
                 # quarantine on the FIRST pass only: later epochs see the
                 # same bad rows again (still counted/enforced), and one
                 # record per bad row beats epochs× duplicates
                 for batch, arrays in steptimer.batches(
-                    self._coordinated_batches(path, quarantine=epoch == 0, skip=skip)
+                    self._coordinated_batches(
+                        epoch_shards, quarantine=epoch == 0, skips=skips
+                    )
                 ):
+                    # which shard fed this step (None = a padding batch):
+                    # popped BEFORE overflow resolution / device transfer
+                    shard_idx = arrays.pop("_shard", None)
                     trace.before_step(res.steps + 1)
                     if step_delay_s:  # drill injector (testing/faults.py)
                         time.sleep(step_delay_s)
@@ -929,8 +1021,14 @@ class Trainer:
                     res.examples += batch.num_rows
                     steps_in_epoch += 1
                     self._examples_seen += batch.num_rows
-                    # the position the NEXT checkpoint's data_state pins
+                    # the position the NEXT checkpoint's data_state pins:
+                    # the global coordinated offset AND this shard's own
+                    # consumed count (the topology-independent truth)
                     self._epoch_pos = (epoch, steps_in_epoch)
+                    if shard_idx is not None:
+                        self._shard_pos[shard_idx] = (
+                            self._shard_pos.get(shard_idx, 0) + 1
+                        )
                     if hb_every and res.steps % hb_every == 0:
                         self.heartbeat.append({"step": res.steps})
                     if stall_s and res.steps == stall_step:
@@ -1014,6 +1112,7 @@ class Trainer:
                     # epoch consumed in full: the stream position rolls
                     # over (an interrupted epoch keeps its mid-epoch pos)
                     self._epoch_pos = (epoch + 1, 0)
+                    self._shard_pos = {}
                 res.epochs = epoch + (0 if stop_sig else 1)
                 if not stop_sig:
                     if (epoch + 1) % 30 == 0:
@@ -1215,21 +1314,31 @@ class Trainer:
         hence rank-symmetric either way).
         """
         cfg = self.cfg
-        path = test_path or shard_path(cfg.data.test_path, self.rank)
+        world = jax.process_count()
+        if test_path:
+            shards: "str | list" = test_path
+        else:
+            # the same elastic assignment as training: after a shrink
+            # the surviving ranks cover the full test record set too
+            shards = assign_shards(
+                cfg.data.test_path, self.rank, world,
+                max(self._num_shards, world),
+            )
         dump = cfg.train.pred_dump if dump is None else dump
-        multiproc = jax.process_count() > 1
+        multiproc = world > 1
         buckets = resolve_eval_buckets(cfg.train.eval_buckets, multiproc)
         if streaming and buckets == 0 and cfg.train.eval_buckets < 0:
             buckets = 65536
         if buckets:
-            return self._evaluate_bucketed(path, buckets, dump, block)
+            return self._evaluate_bucketed(shards, buckets, dump, block)
         dump = dump and (not multiproc or self.rank == 0)
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         pctrs, labels = [], []
         for batch, arrays in self._coordinated_batches(
-            path, with_plan=self._mesh_engine != "replicated",
+            shards, with_plan=self._mesh_engine != "replicated",
             enforce_bad_rows=False, quarantine=False, track_health=False,
         ):
+            arrays.pop("_shard", None)
             arrays = self._resolve_fullshard_overflow(batch, arrays)
             arrays = self._shard_batch(arrays)
             p_dev = self.eval_step(self.state.tables, arrays)
@@ -1268,7 +1377,7 @@ class Trainer:
         return auc, ll
 
     def _evaluate_bucketed(
-        self, path: str, num_buckets: int, dump: bool = False, block: int = 0
+        self, shards, num_buckets: int, dump: bool = False, block: int = 0
     ) -> tuple[float, float]:
         """Streaming eval: local bucket histograms, one collective at the end.
 
@@ -1281,9 +1390,10 @@ class Trainer:
         ll_sum, n_rows = 0.0, 0.0
         fout = open(f"pred_{self.rank}_{block}.txt", "w") if dump else None
         for batch, arrays in self._coordinated_batches(
-            path, with_plan=self._mesh_engine != "replicated",
+            shards, with_plan=self._mesh_engine != "replicated",
             enforce_bad_rows=False, quarantine=False, track_health=False,
         ):
+            arrays.pop("_shard", None)
             arrays = self._resolve_fullshard_overflow(batch, arrays)
             arrays = self._shard_batch(arrays)
             p = self._local_pctrs(self.eval_step(self.state.tables, arrays))
@@ -1323,79 +1433,124 @@ class Trainer:
     # ------------------------------------------------------------- checkpoint
     def _data_state_record(self) -> dict:
         """The host-side data-pipeline position saved alongside every
-        checkpoint (elastic recovery, docs/ROBUSTNESS.md): epoch index,
-        batches consumed within it (the GLOBAL coordinated count — each
-        rank's local offset on resume is min(batches, its shard's batch
-        count), which the skip iterator realizes for free), cumulative
-        per-rank examples, and the quarantine count. `completed` marks
-        a checkpoint written after the configured epochs all ran — a
+        checkpoint (elastic recovery, docs/ROBUSTNESS.md) — the
+        TOPOLOGY-INDEPENDENT v2 form: epoch index, the global
+        coordinated batch offset (informational), per-SHARD consumed
+        batch counts (`shard_batches` — the truth a resume at ANY world
+        size reshards from), the shard set in play (`num_shards`), the
+        GLOBAL cumulative example count, and the quarantine count.
+        Per-rank example counts ride along as information only — they
+        are meaningless across a topology change. `completed` marks a
+        checkpoint written after the configured epochs all ran — a
         resume of a completed run is continuation training and starts a
         fresh pass instead of training nothing. The stream itself is
         deterministic file order (no shuffle stage yet); when one
         lands, its RNG state joins this record — the version field
         exists for exactly that."""
+        from xflow_tpu.train.checkpoint import DATA_STATE_VERSION
+
         epoch, batches = self._epoch_pos
         reg = default_registry()
-        ds = {
-            "version": 1,
-            "epoch": int(epoch),
-            "batches": int(batches),
-            "completed": bool(epoch >= self.cfg.train.epochs),
-            "examples": int(self._examples_seen),
-            "quarantined_rows": int(reg.counter("data.quarantined_rows").value),
-        }
-        if jax.process_count() > 1:
+        world = jax.process_count()
+        num_shards = max(self._num_shards, world, 1)
+        local_shards = np.zeros(num_shards, np.int32)
+        for idx, n in self._shard_pos.items():
+            if 0 <= int(idx) < num_shards:
+                local_shards[int(idx)] = min(int(n), 2**31 - 1)
+        local_ex = np.int32(min(self._examples_seen, 2**31 - 1))
+        if world > 1:
             from jax.experimental import multihost_utils
 
             # collective-safe: save_checkpoint is itself collective, so
-            # every rank reaches this allgather at the same step.
-            # int32: jax without x64 silently truncates int64 inputs
-            per_rank = np.asarray(
-                multihost_utils.process_allgather(
-                    np.int32(min(self._examples_seen, 2**31 - 1))
-                )
-            ).reshape(-1)
-            ds["examples_per_rank"] = [int(x) for x in per_rank]
-        return ds
+            # every rank reaches this allgather at the same step. ONE
+            # stacked [1 + num_shards]-int32 allgather carries both the
+            # example counters and the shard offsets (each shard is
+            # owned by exactly one rank, so the per-shard MAX is the
+            # owner's count). int32: jax without x64 silently truncates
+            # int64 inputs.
+            stacked = np.concatenate([[local_ex], local_shards]).astype(np.int32)
+            got = np.asarray(
+                multihost_utils.process_allgather(stacked)
+            ).reshape(world, -1)
+            per_rank = [int(x) for x in got[:, 0]]
+            shard_batches = got[:, 1:].max(axis=0)
+            examples = int(self._examples_base) + sum(per_rank)
+        else:
+            per_rank = [int(local_ex)]
+            shard_batches = local_shards
+            examples = int(self._examples_base) + int(local_ex)
+        return {
+            "version": DATA_STATE_VERSION,
+            "epoch": int(epoch),
+            "batches": int(batches),
+            "completed": bool(epoch >= self.cfg.train.epochs),
+            "examples": examples,
+            "examples_per_rank": per_rank,
+            "shard_batches": {str(i): int(v) for i, v in enumerate(shard_batches)},
+            "num_shards": int(num_shards),
+            "world_size": int(world),
+            "quarantined_rows": int(reg.counter("data.quarantined_rows").value),
+        }
 
-    def _consume_resume_position(self) -> tuple[int, int]:
-        """(start_epoch, batch_offset) for this fit(), consuming the
-        data_state maybe_restore captured. Fresh runs, pre-v2
-        checkpoints, unreadable data_state, and COMPLETED checkpoints
-        (continuation training) all start at (0, 0); an interrupted
-        run's checkpoint resumes the stream exactly where it stopped."""
-        ds = self._resume_data_state
+    def _consume_resume_position(self) -> tuple[int, dict]:
+        """(start_epoch, {shard index -> batch offset}) for this fit(),
+        consuming the data_state maybe_restore captured. Fresh runs,
+        pre-v2 checkpoints, unreadable data_state, and COMPLETED
+        checkpoints (continuation training) all start at (0, {}); an
+        interrupted run's checkpoint resumes every shard's stream
+        exactly where it stopped — whatever world size wrote it
+        (checkpoint.normalize_data_state folds v1 records into the
+        topology-independent form)."""
+        ds_raw = self._resume_data_state
         self._resume_data_state = None
-        if not isinstance(ds, dict) or ds.get("completed"):
-            return 0, 0
+        from xflow_tpu.train.checkpoint import normalize_data_state
+
+        if not isinstance(ds_raw, dict) or ds_raw.get("completed"):
+            if isinstance(ds_raw, dict):
+                # continuation training starts a fresh pass, but the
+                # RECORD SET the completed checkpoint covered still
+                # applies — a shrunk world keeps covering every shard
+                try:
+                    self._num_shards = max(
+                        self._num_shards,
+                        normalize_data_state(ds_raw)["num_shards"],
+                    )
+                except (TypeError, ValueError):
+                    pass
+            return 0, {}
         try:
-            epoch = max(int(ds.get("epoch", 0)), 0)
-            batches = max(int(ds.get("batches", 0)), 0)
-            # THIS rank's consumed-example counter, not rank 0's: on
-            # ragged shards the counts differ per rank, and adopting the
-            # writer's scalar would inflate every later checkpoint's
-            # accounting on the shorter ranks
-            per_rank = ds.get("examples_per_rank")
-            if isinstance(per_rank, list) and self.rank < len(per_rank):
-                self._examples_seen = max(int(per_rank[self.rank]), 0)
-            else:
-                self._examples_seen = max(int(ds.get("examples", 0)), 0)
+            ds = normalize_data_state(ds_raw)
         except (TypeError, ValueError):
             print(
                 "xflow: warning: checkpoint data_state is malformed; "
                 "resuming with a fresh data stream",
                 file=sys.stderr,
             )
-            return 0, 0
-        if epoch or batches:
+            return 0, {}
+        # GLOBAL example accounting survives any topology change: the
+        # restored total becomes the base, and every rank's local
+        # counter restarts at 0 for this generation
+        self._examples_base = ds["examples"]
+        self._examples_seen = 0
+        self._num_shards = max(self._num_shards, ds["num_shards"])
+        epoch, skips = ds["epoch"], ds["shard_batches"]
+        world = jax.process_count()
+        if epoch or any(skips.values()):
             from xflow_tpu.telemetry import resolve_restart_gen
 
+            note = (
+                f"; resharding {ds['num_shards']} shard(s) from "
+                f"{ds['world_size']} rank(s) onto {world}"
+                if ds["world_size"] != world
+                else ""
+            )
             print(
-                f"resuming data stream at epoch {epoch}, batch offset "
-                f"{batches} (restart generation {resolve_restart_gen()})",
+                f"resuming data stream at epoch {epoch}, shard offsets "
+                f"{[skips.get(i, 0) for i in range(ds['num_shards'])]} "
+                f"(restart generation {resolve_restart_gen()}){note}",
                 file=sys.stderr,
             )
-        return epoch, batches
+        return epoch, skips
 
     def save_checkpoint(self) -> None:
         from xflow_tpu.train import checkpoint as ckpt
@@ -1449,12 +1604,19 @@ class Trainer:
         cdir = self.cfg.train.checkpoint_dir
         fmt = self.cfg.train.checkpoint_format
         # self-healing restore: the newest checkpoint failing to load
-        # (truncated npz, corrupt orbax shard) walks back to the previous
-        # committed step instead of killing the resume (restore_any logs
-        # what it skipped and why). No checkpoint at all = fresh start;
-        # raises only when checkpoints exist and NONE loads.
+        # (truncated npz, corrupt orbax shard, a DIGEST mismatch against
+        # the meta written at save — the silent-bit-flip case) walks
+        # back to the previous committed step instead of killing the
+        # resume (restore_any logs what it skipped and why). The
+        # restore itself is topology-agnostic: each leaf lands on the
+        # CURRENT state's sharding, whatever world size/engine wrote
+        # the checkpoint. No checkpoint at all = fresh start; raises
+        # only when checkpoints exist and NONE loads.
         try:
-            self.state, step = ckpt.restore_any(cdir, self.state, fmt=fmt)
+            self.state, step = ckpt.restore_any(
+                cdir, self.state, fmt=fmt,
+                verify=self.cfg.train.checkpoint_verify,
+            )
         except FileNotFoundError:
             return False
         # the data-stream position travels with the step that actually
