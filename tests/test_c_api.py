@@ -1,7 +1,11 @@
-"""C-ABI embedding test: compile a real C client, link the shim, train.
+"""C-ABI embedding test: compile a real C client, link the shim, and
+drive the full lifecycle — train -> checkpoint -> XFLoadCheckpoint ->
+XFPredict.
 
 The reference's C API (C14) is disabled in its build and cannot compile
-as shipped; this verifies ours actually embeds and trains end-to-end.
+as shipped; this verifies ours actually embeds, trains, and serves
+predictions from the committed checkpoint end-to-end (the serving
+surface the reference never finished).
 """
 
 import os
@@ -23,6 +27,8 @@ CLIENT = r"""
 #include <stdio.h>
 #include "xflow_c_api.h"
 
+/* argv: train_prefix test_prefix checkpoint_dir
+ * Full lifecycle: train -> checkpoint -> load -> predict. */
 int main(int argc, char** argv) {
   void* h = 0;
   if (XFCreate(&h, argv[1], argv[2]) != 0) return 2;
@@ -31,11 +37,28 @@ int main(int argc, char** argv) {
   if (XFSetConfig(h, "data.log2_slots", "12") != 0) return 3;
   if (XFSetConfig(h, "model.num_fields", "5") != 0) return 3;
   if (XFSetConfig(h, "train.pred_dump", "false") != 0) return 3;
+  if (XFSetConfig(h, "train.checkpoint_dir", argv[3]) != 0) return 3;
   if (XFStartTrain(h) != 0) return 4;
   double auc = XFGetAUC(h);
   printf("AUC=%.4f\n", auc);
+  if (auc <= 0.7) return 5;
+
+  /* predicting before a load must fail cleanly, not crash */
+  double pre[1];
+  if (XFPredict(h, "0:a 1:b", pre, 1) != -1) return 6;
+
+  if (XFLoadCheckpoint(h, argv[3]) != 0) return 7;
+  double p[4];
+  int n = XFPredict(h, "0:f0x 1:f1y 2:f2z\n1\t0:q 3:r\n4:s", p, 4);
+  if (n != 3) { printf("XFPredict wrote %d rows, want 3\n", n); return 8; }
+  for (int i = 0; i < 3; ++i) {
+    printf("PCTR=%.6f\n", p[i]);
+    if (!(p[i] > 0.0 && p[i] < 1.0)) return 9;
+  }
+  /* a malformed row errors (the quarantine philosophy), never crashes */
+  if (XFPredict(h, "no-colon-tokens", p, 4) != -1) return 10;
   XFDestroy(h);
-  return (auc > 0.7) ? 0 : 5;
+  return 0;
 }
 """
 
@@ -64,7 +87,8 @@ def test_c_client_trains(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     # evaluate on the train shard: the gate is that embedding works
     r = subprocess.run(
-        [str(exe), str(tmp_path / "train"), str(tmp_path / "train")],
+        [str(exe), str(tmp_path / "train"), str(tmp_path / "train"),
+         str(tmp_path / "ckpt")],
         capture_output=True,
         text=True,
         env=env,
@@ -73,3 +97,5 @@ def test_c_client_trains(tmp_path):
     )
     assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
     assert r.stdout.startswith("AUC=")
+    # the serving half: three predictions from the loaded checkpoint
+    assert r.stdout.count("PCTR=") == 3, r.stdout

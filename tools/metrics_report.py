@@ -69,6 +69,27 @@ WINDOW_KEYS = (
 # .HealthMonitor.window_record); --check enforces all-or-none too
 HEALTH_KEYS = ("grad_norm", "update_norm", "param_norm", "loss_ema")
 STAMP_KEYS = ("ts", "rank", "run_id")
+# the key set every kind="serve" window record carries (serve/metrics
+# .ServeMetrics.maybe_flush — SERVE_WINDOW_KEYS there is the writer's
+# copy); --check enforces all-or-none plus monotone model generation
+SERVE_KEYS = (
+    "requests",
+    "rows",
+    "qps",
+    "rows_per_s",
+    "batches",
+    "batch_fill",
+    "queue_wait_p50_ms",
+    "queue_wait_p99_ms",
+    "device_p50_ms",
+    "device_p99_ms",
+    "total_p50_ms",
+    "total_p99_ms",
+    "window_s",
+    "bad_requests",
+    "generation",
+    "step",
+)
 
 
 def expand_paths(paths: list[str]) -> list[str]:
@@ -129,6 +150,16 @@ def metrics_streams(streams: dict) -> dict:
         (rid, rank, gen): recs
         for (rid, rank, kind, gen), recs in streams.items()
         if kind == "metrics"
+    }
+
+
+def serve_streams(streams: dict) -> dict:
+    """The (run_id, rank, gen) -> records subset holding serving
+    telemetry (kind="serve": QPS/latency windows + reload events)."""
+    return {
+        (rid, rank, gen): recs
+        for (rid, rank, kind, gen), recs in streams.items()
+        if kind == "serve"
     }
 
 
@@ -203,6 +234,62 @@ def summarize_stream(records: list[dict]) -> dict:
     }
 
 
+def summarize_serve_stream(records: list[dict]) -> dict:
+    """One summary row for a (run_id, rank) kind="serve" stream:
+    traffic totals over the window records, latency aggregated across
+    windows (p50 = median of window p50s, p99 = max of window p99s —
+    conservative for a tail), the reload-event count, and the
+    generation trail."""
+    windows = [r for r in records if "qps" in r]
+    total_rows = sum(r.get("rows", 0) for r in windows if _finite(r.get("rows")))
+    total_reqs = sum(
+        r.get("requests", 0) for r in windows if _finite(r.get("requests"))
+    )
+    total_s = sum(
+        r.get("window_s", 0.0) for r in windows if _finite(r.get("window_s"))
+    )
+    p50s = [r["total_p50_ms"] for r in windows if _finite(r.get("total_p50_ms"))]
+    p99s = [r["total_p99_ms"] for r in windows if _finite(r.get("total_p99_ms"))]
+    fills = [
+        (r["batch_fill"], r["batches"])
+        for r in windows
+        if _finite(r.get("batch_fill")) and _finite(r.get("batches"))
+    ]
+    fill_w = sum(n for _, n in fills)
+    gens = []
+    for r in records:
+        g = r.get("generation")
+        if _finite(g) and (not gens or gens[-1] != g):
+            gens.append(g)
+    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else float("nan")
+    return {
+        "windows": len(windows),
+        "requests": int(total_reqs),
+        "rows": int(total_rows),
+        "window_seconds": float(total_s),
+        "qps": total_reqs / total_s if total_s > 0 else float("nan"),
+        "rows_per_s": total_rows / total_s if total_s > 0 else float("nan"),
+        "p50_ms": med(p50s),
+        "p99_ms": max(p99s) if p99s else float("nan"),
+        "batch_fill": (
+            sum(f * n for f, n in fills) / fill_w if fill_w else float("nan")
+        ),
+        "bad_requests": int(
+            sum(r.get("bad_requests", 0) for r in windows
+                if _finite(r.get("bad_requests")))
+        ),
+        "reloads": sum(1 for r in records if r.get("event") == "reload"),
+        "reload_failures": sum(
+            1 for r in records if r.get("event") == "reload_failed"
+        ),
+        "generations": gens,
+        "last_step": next(
+            (r["step"] for r in reversed(records) if _finite(r.get("step"))),
+            -1,
+        ),
+    }
+
+
 def check_streams(streams: dict, files: list[str]) -> list[str]:
     """Schema violations ([] = clean). The contract checked here is the
     one docs/OBSERVABILITY.md documents — keep the three in sync.
@@ -247,6 +334,9 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         last_step = -1
         step_recs = 0
         window_recs = 0
+        last_model_gen = -1  # serve streams: the model generation a
+        # record answered with must never regress (hot reload only
+        # moves forward; a regression means a swap raced or went back)
         for i, rec in enumerate(records, 1):
             for key in STAMP_KEYS:
                 if key not in rec:
@@ -262,7 +352,10 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                             f"({last_step} -> {rec['step']}) at record {i}"
                         )
                     last_step = max(last_step, rec["step"])
-            present = [k for k in WINDOW_KEYS if k in rec]
+            # the StepTimer window contract is the TRAINER stream's
+            # ("rows_per_s" also lives in serve windows, which have
+            # their own key set below)
+            present = [k for k in WINDOW_KEYS if k in rec] if kind == "metrics" else []
             if present:
                 window_recs += 1
                 missing = [k for k in WINDOW_KEYS if k not in rec]
@@ -292,6 +385,33 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                     f"{tag}: record {i} is neither a step heartbeat nor "
                     "an event"
                 )
+            if kind == "serve":
+                s_present = [k for k in SERVE_KEYS if k in rec]
+                if "event" in rec:
+                    if not isinstance(rec["event"], str):
+                        problems.append(
+                            f"{tag}: record {i} has a non-string event"
+                        )
+                elif s_present:
+                    s_missing = [k for k in SERVE_KEYS if k not in rec]
+                    if s_missing:
+                        problems.append(
+                            f"{tag}: record {i} has serve keys "
+                            f"{s_present[:3]}... but lacks {s_missing}"
+                        )
+                else:
+                    problems.append(
+                        f"{tag}: record {i} is neither a serve window "
+                        "nor an event"
+                    )
+                mg = rec.get("generation")
+                if _finite(mg):
+                    if mg < last_model_gen:
+                        problems.append(
+                            f"{tag}: model generation went backwards "
+                            f"({last_model_gen} -> {mg}) at record {i}"
+                        )
+                    last_model_gen = max(last_model_gen, mg)
         if kind == "metrics" and step_recs >= 2 and window_recs == 0:
             problems.append(
                 f"{tag}: {step_recs} step records but no window record — "
@@ -393,6 +513,89 @@ def bench_record(streams: dict) -> dict:
             rec["auc"] = round(max(aucs), 6)
             break
     return rec
+
+
+def serve_bench_record(streams: dict) -> dict:
+    """BENCH-style SERVE perf record over the newest run (the shape
+    tools/serve_bench.py emits, computed from the server's own
+    telemetry instead of the client's) — the --bench-json fallback
+    when a run dir holds serving streams but no trainer metrics, so a
+    serving run feeds the BENCH_SERVE.json trajectory without a
+    separate loadgen pass."""
+    if not streams:
+        return {}
+    newest = _newest_run(streams)
+    rows = {
+        key: summarize_serve_stream(recs)
+        for key, recs in serve_streams(streams).items()
+        if key[0] == newest
+    }
+    rows = {k: s for k, s in rows.items() if s["windows"]}
+    if not rows:
+        return {}
+    reqs = sum(s["requests"] for s in rows.values())
+    total_rows = sum(s["rows"] for s in rows.values())
+    # QPS: ranks serve CONCURRENTLY (their rates add); one rank's
+    # restart generations run SEQUENTIALLY (they time-weight, never
+    # add — summing would double a restarted server's trajectory)
+    per_rank: dict = {}
+    for (rid, rank, gen), s in rows.items():
+        agg = per_rank.setdefault(rank, [0, 0.0])
+        agg[0] += s["requests"]
+        agg[1] += s["window_seconds"]
+    qps = sum(r / t for r, t in per_rank.values() if t > 0)
+    p50s = [s["p50_ms"] for s in rows.values() if _finite(s["p50_ms"])]
+    p99s = [s["p99_ms"] for s in rows.values() if _finite(s["p99_ms"])]
+    fills = [s["batch_fill"] for s in rows.values() if _finite(s["batch_fill"])]
+    gens = sorted({g for s in rows.values() for g in s["generations"]})
+    return {
+        "metric": "serve_qps",
+        "value": round(qps, 2),
+        "unit": "requests/sec",
+        "source": "serve_telemetry",
+        "run_id": newest,
+        "requests": int(reqs),
+        "rows": int(total_rows),
+        "p50_ms": round(sorted(p50s)[len(p50s) // 2], 3) if p50s else None,
+        "p99_ms": round(max(p99s), 3) if p99s else None,
+        "batch_fill": round(sum(fills) / len(fills), 4) if fills else None,
+        "bad_requests": int(sum(s["bad_requests"] for s in rows.values())),
+        "reloads": int(sum(s["reloads"] for s in rows.values())),
+        "generations": gens,
+    }
+
+
+def render_serve_table(streams: dict) -> str:
+    """The serving summary block: one row per (run_id, rank, gen)
+    serve stream."""
+    header = (
+        "run_id", "rank", "gen", "windows", "requests", "rows", "qps",
+        "p50_ms", "p99_ms", "fill", "bad", "reloads", "step",
+    )
+
+    def fmt(v):
+        if isinstance(v, float):
+            return "-" if not math.isfinite(v) else f"{v:.4g}"
+        return str(v)
+
+    rows = []
+    for (run_id, rank, gen), recs in sorted(serve_streams(streams).items(), key=str):
+        s = summarize_serve_stream(recs)
+        rows.append((
+            run_id, rank, gen, s["windows"], s["requests"], s["rows"],
+            s["qps"], s["p50_ms"], s["p99_ms"], s["batch_fill"],
+            s["bad_requests"], s["reloads"], s["last_step"],
+        ))
+    if not rows:
+        return ""
+    cells = [header] + [tuple(fmt(c) for c in row) for row in rows]
+    widths = [max(len(r[i]) for r in cells) for i in range(len(header))]
+    lines = ["serving (kind=serve):"]
+    for i, row in enumerate(cells):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
 
 
 # ----------------------------------------------------------------- --health
@@ -602,16 +805,21 @@ def main(argv=None) -> int:
                 s["data_wait_ms"], s["last_loss"], s["bad_steps"], s["bad_rows"],
                 s["eval_auc"],
             ))
+        serve_table = render_serve_table(streams)
         if rows:
             print(render_table(rows))
-        else:
+        if serve_table:
+            print(serve_table)
+        if not rows and not serve_table:
             print("metrics_report: no records found", file=sys.stderr)
             return 1
     if skipped:
         print(f"# {skipped} damaged line(s) skipped (truncated append?)")
 
     if args.bench_json:
-        rec = bench_record(streams)
+        # trainer record when the run trained; else the serving record
+        # (a serve-only run dir feeds the BENCH_SERVE.json trajectory)
+        rec = bench_record(streams) or serve_bench_record(streams)
         out = json.dumps(rec)
         if args.bench_json == "-":
             print(out)

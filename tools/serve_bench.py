@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""Load generator for `xflow serve` (docs/SERVING.md).
+
+Closed loop (default): `--concurrency` workers each keep exactly one
+request in flight — the classic saturation probe; QPS is what the
+server sustains. Open loop (`--rate R`): workers schedule arrivals at
+a fixed aggregate rate regardless of completions — the tail-latency-
+honest mode (a closed loop self-throttles when the server slows,
+hiding queueing delay; the open loop keeps pushing like real traffic).
+
+Rows come from a libffm file (`--data`; labels are stripped — serving
+requests carry features only) or a synthesized pool. Every response's
+`generation` is tracked, so a hot checkpoint reload mid-run shows up
+as a generation flip in the report — tools/smoke_serve.sh gates on
+exactly that (flip observed, zero errors, zero drops).
+
+    python tools/serve_bench.py --url http://127.0.0.1:8000 --duration 10
+    python tools/serve_bench.py --unix /tmp/serve.sock --rate 500 \
+        --data /tmp/test-00000 --bench-json BENCH_SERVE.json
+
+The `--bench-json` record is BENCH-shaped ({"metric": "serve_qps", ...}
+with latency percentiles riding along) — the serving analog of
+bench.py's training record, feeding the BENCH_SERVE.json trajectory.
+Exit status: nonzero when any request errored (use in CI gates).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import socket
+import sys
+import threading
+import time
+
+
+class UnixHTTPConnection(http.client.HTTPConnection):
+    """http.client over an AF_UNIX path (the colocated-client mode)."""
+
+    def __init__(self, path: str, timeout: float = 30.0):
+        super().__init__("localhost", timeout=timeout)
+        self._path = path
+
+    def connect(self):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(self.timeout)
+        self.sock.connect(self._path)
+
+
+def _connect(args):
+    if args.unix:
+        return UnixHTTPConnection(args.unix, timeout=args.timeout)
+    host, _, port = args.url.rpartition("//")[2].partition(":")
+    return http.client.HTTPConnection(
+        host or "127.0.0.1", int(port or 80), timeout=args.timeout
+    )
+
+
+def load_rows(path: str, limit: int = 100000) -> list:
+    """Feature rows from a libffm file: label stripped, features kept
+    verbatim (the same tokens hash to the same slots server-side)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split("\t", 1)
+            if len(parts) == 1:
+                parts = line.split(" ", 1)
+            rows.append(parts[1] if len(parts) > 1 else parts[0])
+            if len(rows) >= limit:
+                break
+    if not rows:
+        raise SystemExit(f"serve_bench: no rows in {path!r}")
+    return rows
+
+
+def synth_rows(n: int = 1024, num_fields: int = 18) -> list:
+    # deterministic pool: the bench must not depend on a data file
+    return [
+        " ".join(f"{f}:synth{(i * 31 + f * 7) % 997}" for f in range(num_fields))
+        for i in range(n)
+    ]
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list = []
+        self.requests = 0
+        self.rows = 0
+        self.errors = 0
+        self.generations: list = []  # (t, gen) observations in order
+        self.steps: set = set()
+
+    def ok(self, t: float, lat_s: float, n_rows: int, gen: int, step: int):
+        with self.lock:
+            self.requests += 1
+            self.rows += n_rows
+            self.latencies.append(lat_s)
+            if not self.generations or self.generations[-1][1] != gen:
+                self.generations.append((t, gen))
+            self.steps.add(step)
+
+    def err(self):
+        with self.lock:
+            self.requests += 1
+            self.errors += 1
+
+
+def worker(args, rows, stats: Stats, deadline: float, interval_s: float, stop):
+    conn = _connect(args)
+    i = 0
+    next_at = time.perf_counter()
+    while not stop.is_set():
+        now = time.perf_counter()
+        if now >= deadline:
+            break
+        if interval_s > 0:  # open loop: hold the schedule
+            if now < next_at:
+                time.sleep(min(next_at - now, deadline - now))
+                continue
+            next_at += interval_s
+        batch = [rows[(i * 13 + j) % len(rows)] for j in range(args.rows_per_request)]
+        i += 1
+        body = json.dumps({"rows": batch})
+        t0 = time.perf_counter()
+        try:
+            conn.request(
+                "POST", "/predict", body, {"Content-Type": "application/json"}
+            )
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            if resp.status != 200 or len(payload.get("pctr", [])) != len(batch):
+                stats.err()
+                continue
+        except Exception:
+            stats.err()
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = _connect(args)
+            continue
+        t1 = time.perf_counter()
+        stats.ok(
+            t1, t1 - t0, len(batch), payload.get("generation", 0),
+            payload.get("step", -1),
+        )
+    try:
+        conn.close()
+    except Exception:
+        pass
+
+
+def percentile(xs: list, q: float) -> float:
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    idx = min(int(len(xs) * q / 100.0), len(xs) - 1)
+    return xs[idx]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="loadgen for `xflow serve`")
+    ap.add_argument("--url", default="http://127.0.0.1:8000")
+    ap.add_argument("--unix", default="", help="AF_UNIX socket path (overrides --url)")
+    ap.add_argument("--data", default="", help="libffm file to draw rows from "
+                                               "(default: synthesized pool)")
+    ap.add_argument("--duration", type=float, default=10.0, help="seconds")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop aggregate requests/s (0 = closed loop)")
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument("--num-fields", type=int, default=18,
+                    help="fields in synthesized rows (ignored with --data)")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--bench-json", default="",
+                    help="write a BENCH-style serve perf JSON here ('-' = stdout)")
+    args = ap.parse_args(argv)
+
+    rows = load_rows(args.data) if args.data else synth_rows(num_fields=args.num_fields)
+    stats = Stats()
+    stop = threading.Event()
+    # open loop: each worker holds rate/concurrency; closed loop: 0
+    interval = args.concurrency / args.rate if args.rate > 0 else 0.0
+    t0 = time.perf_counter()
+    deadline = t0 + args.duration
+    threads = [
+        threading.Thread(
+            target=worker, args=(args, rows, stats, deadline, interval, stop),
+            daemon=True,
+        )
+        for _ in range(args.concurrency)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join(timeout=args.duration + args.timeout + 10)
+    except KeyboardInterrupt:
+        stop.set()
+    elapsed = time.perf_counter() - t0
+
+    lat = stats.latencies
+    gens = [g for _, g in stats.generations]
+    rec = {
+        "metric": "serve_qps",
+        "value": round((stats.requests - stats.errors) / max(elapsed, 1e-9), 2),
+        "unit": "requests/sec",
+        "mode": f"open@{args.rate}/s" if args.rate > 0 else
+                f"closed@{args.concurrency}",
+        "requests": stats.requests,
+        "errors": stats.errors,
+        "rows": stats.rows,
+        "rows_per_s": round(stats.rows / max(elapsed, 1e-9), 1),
+        "p50_ms": round(percentile(lat, 50) * 1e3, 3),
+        "p99_ms": round(percentile(lat, 99) * 1e3, 3),
+        "duration_s": round(elapsed, 3),
+        "rows_per_request": args.rows_per_request,
+        # the hot-reload trail: distinct generations answered, in
+        # arrival order; >1 entries = a reload flipped mid-run
+        "generations": gens,
+        "gen_flips": max(len(gens) - 1, 0),
+        "steps": sorted(stats.steps),
+    }
+    out = json.dumps(rec)
+    print(out)  # the one JSON line consumers parse
+    if args.bench_json and args.bench_json != "-":  # '-' already printed
+        with open(args.bench_json, "w") as f:
+            f.write(out + "\n")
+    return 1 if stats.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

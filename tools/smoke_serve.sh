@@ -1,0 +1,189 @@
+#!/usr/bin/env bash
+# Serving smoke gate (docs/SERVING.md):
+#
+# 1. Train a small LR run with committed checkpoints every 10 steps
+#    (10..50), and dump the FINAL state's evaluate() probabilities on a
+#    held-out request set — the offline side of the parity pin.
+# 2. Stage the step-20 checkpoint into a serving dir (atomic rename —
+#    the shipping contract), start `xflow serve` on a free port with a
+#    3 ms coalescing window, and wait for the ready line.
+# 3. Drive tools/serve_bench.py closed-loop against it; MID-LOAD,
+#    atomically commit the step-50 checkpoint into the serving dir.
+#    The watcher must hot-reload it: the bench report must show a
+#    generation flip (steps 20 -> 50) with ZERO failed requests — the
+#    swap drops and blocks nothing. Emits BENCH_SERVE.json
+#    (docs/PERF.md "Bench trajectory").
+# 4. Parity: POST the held-out rows and compare the served pCTRs
+#    against step 1's evaluate() dump (same rows, same checkpoint,
+#    float tolerance) — online serving == offline eval, pinned.
+# 5. tools/metrics_report.py --check green on the kind="serve" stream,
+#    the reload event present, and a graceful SIGTERM shutdown.
+#
+# Standalone:    bash tools/smoke_serve.sh [workdir]
+# From pytest:   tests/test_serve.py::test_smoke_serve_script
+set -eu
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# bench datapoint destination: the repo root ONLY standalone (the
+# per-PR record); under pytest it stays in the workdir
+BENCH_OUT="$ROOT/BENCH_SERVE.json"
+SERVE_PID=""
+cleanup() {
+    if [ -n "$SERVE_PID" ]; then kill -9 "$SERVE_PID" 2>/dev/null || true; fi
+    if [ -n "${TMP_WORK:-}" ]; then rm -rf "$TMP_WORK"; fi
+}
+trap cleanup EXIT
+if [ -z "$WORK" ]; then
+    TMP_WORK="$(mktemp -d)"
+    WORK="$TMP_WORK"
+else
+    BENCH_OUT="$WORK/BENCH_SERVE.json"
+fi
+
+export JAX_PLATFORMS=cpu
+# single CPU device (xargs trims; an empty result must UNSET the var —
+# XLA treats a whitespace-only value as a flags FILE to open and aborts)
+XLA_FLAGS="$(printf '%s\n' ${XLA_FLAGS:-} \
+    | grep -v xla_force_host_platform_device_count | xargs || true)"
+if [ -n "$XLA_FLAGS" ]; then export XLA_FLAGS; else unset XLA_FLAGS; fi
+
+MODEL_ARGS=(--model lr --log2-slots 12
+            --set model.num_fields=6 --set data.max_nnz=8)
+
+# ---- 1. train with a checkpoint trail + offline parity dump ---------------
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+python -m xflow_tpu gen-data "$WORK/reqs" --shards 1 --rows 512 \
+    --fields 6 --ids-per-field 50 --seed 9 --truth-seed 0 >/dev/null
+
+python -m xflow_tpu train --train "$WORK/train" "${MODEL_ARGS[@]}" \
+    --epochs 1 --batch-size 64 --checkpoint-dir "$WORK/ck" \
+    --set train.checkpoint_every=10 --set train.pred_dump=false \
+    --set train.log_every=10 >/dev/null 2>"$WORK/train.log"
+
+# offline side of the parity pin: evaluate() probabilities from the
+# FINAL (step-50) checkpoint on the request rows
+(cd "$WORK" && python - "$WORK" <<'EOF'
+import sys
+from xflow_tpu.config import Config, override
+from xflow_tpu.train.trainer import Trainer
+
+work = sys.argv[1]
+cfg = override(Config(), **{
+    "model.name": "lr", "data.log2_slots": 12, "model.num_fields": 6,
+    "data.max_nnz": 8, "data.batch_size": 64,
+    "train.checkpoint_dir": f"{work}/ck",
+})
+t = Trainer(cfg)
+assert t.maybe_restore(), "no checkpoint restored"
+assert int(t.state.step) == 50, int(t.state.step)
+t.evaluate(test_path=f"{work}/reqs-00000", dump=True, block=0)
+EOF
+)
+[ -s "$WORK/pred_0_0.txt" ] || { echo "smoke_serve: no eval dump"; exit 1; }
+
+# ---- 2. stage step-20 and start the server --------------------------------
+stage() {  # atomic checkpoint shipping: payload under a temp name, one rename
+    python - "$WORK/ck" "$WORK/serve_ck" "$1" <<'EOF'
+import os, shutil, sys
+src, dst, step = sys.argv[1], sys.argv[2], sys.argv[3]
+os.makedirs(dst, exist_ok=True)
+tmp = os.path.join(dst, f".staging_{step}")
+if os.path.exists(tmp):
+    shutil.rmtree(tmp)
+shutil.copytree(os.path.join(src, f"step_{step}"), tmp)
+os.replace(tmp, os.path.join(dst, f"step_{step}"))
+EOF
+}
+stage 20
+
+mkdir -p "$WORK/run_serve"
+python -m xflow_tpu serve --checkpoint-dir "$WORK/serve_ck" "${MODEL_ARGS[@]}" \
+    --port 0 --window-ms 3 --max-batch 64 --poll-s 0.3 --no-mesh \
+    --metrics-path "$WORK/run_serve/serve_rank0.jsonl" \
+    --set serve.metrics_every_s=1 \
+    >"$WORK/serve_ready.json" 2>"$WORK/serve.log" &
+SERVE_PID=$!
+
+for i in $(seq 1 240); do
+    [ -s "$WORK/serve_ready.json" ] && break
+    kill -0 "$SERVE_PID" 2>/dev/null || {
+        echo "smoke_serve: server died during startup"; cat "$WORK/serve.log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$WORK/serve_ready.json" ] || {
+    echo "smoke_serve: server never became ready"; cat "$WORK/serve.log"; exit 1; }
+PORT=$(python -c "import json,sys; print(json.load(open(sys.argv[1]))['port'])" \
+    "$WORK/serve_ready.json")
+grep -q '"step": 20' "$WORK/serve_ready.json" || {
+    echo "smoke_serve: server did not start at step 20"; cat "$WORK/serve_ready.json"; exit 1; }
+
+# ---- 3. loadgen + hot reload mid-load -------------------------------------
+python tools/serve_bench.py --url "http://127.0.0.1:$PORT" \
+    --data "$WORK/reqs-00000" --duration 8 --concurrency 4 \
+    --rows-per-request 4 --bench-json "$BENCH_OUT" \
+    >"$WORK/bench_report.json" 2>"$WORK/bench.log" &
+BENCH_PID=$!
+sleep 2.5
+stage 50   # a NEWER checkpoint commits while requests are in flight
+rc=0; wait "$BENCH_PID" || rc=$?
+[ "$rc" -eq 0 ] || {
+    echo "smoke_serve: loadgen saw failed requests"
+    cat "$WORK/bench_report.json" "$WORK/serve.log"; exit 1; }
+
+python - "$BENCH_OUT" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["errors"] == 0, rec
+assert rec["gen_flips"] >= 1, f"no hot-reload generation flip: {rec}"
+assert rec["steps"] == [20, 50], f"served steps {rec['steps']} != [20, 50]"
+assert rec["value"] > 0 and rec["p99_ms"] > 0, rec
+print("smoke_serve: hot reload OK "
+      f"(qps {rec['value']}, p50 {rec['p50_ms']}ms, p99 {rec['p99_ms']}ms, "
+      f"generations {rec['generations']}, {rec['requests']} requests, "
+      "0 dropped)")
+EOF
+
+# ---- 4. online == offline parity ------------------------------------------
+python - "$WORK" "$PORT" <<'EOF'
+import http.client, json, sys
+
+work, port = sys.argv[1], int(sys.argv[2])
+rows = [l.split("\t", 1)[1].strip()
+        for l in open(f"{work}/reqs-00000").read().splitlines() if l.strip()]
+preds = [float(l.split("\t")[0])
+         for l in open(f"{work}/pred_0_0.txt").read().splitlines()]
+assert len(rows) == len(preds), (len(rows), len(preds))
+conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+conn.request("GET", "/healthz")
+h = json.loads(conn.getresponse().read())
+assert h["step"] == 50, f"server not on step 50 after reload: {h}"
+served = []
+for lo in range(0, len(rows), 32):
+    body = json.dumps({"rows": rows[lo:lo + 32]})
+    conn.request("POST", "/predict", body, {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    payload = json.loads(resp.read())
+    assert resp.status == 200, payload
+    served.extend(payload["pctr"])
+worst = max(abs(a - b) for a, b in zip(served, preds))
+assert worst < 1e-5, f"serve/eval divergence {worst}"
+print(f"smoke_serve: parity OK ({len(rows)} rows, max |serve-eval| {worst:.2e})")
+EOF
+
+# ---- 5. telemetry gate + graceful shutdown --------------------------------
+kill -TERM "$SERVE_PID"
+rc=0; wait "$SERVE_PID" || rc=$?
+SERVE_PID=""
+[ "$rc" -eq 0 ] || { echo "smoke_serve: server exit $rc"; cat "$WORK/serve.log"; exit 1; }
+
+python tools/metrics_report.py "$WORK/run_serve" --check
+grep -q '"event": "reload"' "$WORK/run_serve/serve_rank0.jsonl" || {
+    echo "smoke_serve: no reload event in the serve stream"; exit 1; }
+# the server-side bench record agrees the run served traffic
+python tools/metrics_report.py "$WORK/run_serve" --bench-json - \
+    | grep -q serve_qps || { echo "smoke_serve: no serve bench record"; exit 1; }
+
+echo "smoke_serve: OK"

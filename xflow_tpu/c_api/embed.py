@@ -52,5 +52,46 @@ def get_auc(handle: int) -> float:
     return float(_registry[handle]["auc"])
 
 
+def load_checkpoint(handle: int, ckpt_dir: str) -> int:
+    """Back XFLoadCheckpoint: stand up a serve runner over the newest
+    COMMITTED checkpoint in `ckpt_dir` (reshard-on-load; walk-back on
+    corrupt steps — train/checkpoint.restore_any), using this handle's
+    accumulated config overrides so the model/hash config matches what
+    trained. The reference's c_api was exactly this embedding-serving
+    surface, never finished (`/root/reference/src/c_api`, disabled in
+    its build)."""
+    from xflow_tpu.config import Config, override
+    from xflow_tpu.serve.runner import ServeRunner
+
+    entry = _registry[handle]
+    overrides = dict(entry["overrides"])
+    overrides["train.checkpoint_dir"] = ckpt_dir
+    cfg = override(Config(), **overrides)
+    runner = ServeRunner(cfg)
+    runner.load()  # raises when nothing committed loads -> C returns -1
+    entry["runner"] = runner
+    return 0
+
+
+def predict(handle: int, rows_text: str) -> list:
+    """Back XFPredict: newline-separated libffm feature rows (optional
+    leading label ignored) -> [pctr floats], through the SAME jitted
+    forward `evaluate` uses (models/predict.py). Raises on malformed
+    rows or a handle without a loaded checkpoint — the C shim surfaces
+    that as -1, never a crash."""
+    runner = _registry[handle].get("runner")
+    if runner is None:
+        raise RuntimeError("no checkpoint loaded; call XFLoadCheckpoint first")
+    rows = [ln for ln in rows_text.splitlines() if ln.strip()]
+    pctrs, _gen = runner.predict_rows(rows)
+    return [float(p) for p in pctrs]
+
+
+def get_serving_step(handle: int) -> int:
+    """Checkpoint step the handle's runner serves (-1 = none loaded)."""
+    runner = _registry[handle].get("runner")
+    return int(runner.step) if runner is not None else -1
+
+
 def destroy(handle: int) -> None:
     _registry.pop(handle, None)

@@ -98,6 +98,52 @@ double XFGetAUC(void* handle) {
   return auc;
 }
 
+int XFLoadCheckpoint(void* handle, const char* checkpoint_dir) {
+  if (ensure_interp() != 0) return -1;
+  PyObject* r = call("load_checkpoint",
+                     Py_BuildValue("(ls)", (long)(intptr_t)handle, checkpoint_dir));
+  if (r == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  long rc = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (rc == -1 && PyErr_Occurred()) {
+    PyErr_Print();
+    return -1;
+  }
+  return (int)rc;
+}
+
+int XFPredict(void* handle, const char* rows, double* out_pctr, int capacity) {
+  if (ensure_interp() != 0) return -1;
+  PyObject* r = call("predict",
+                     Py_BuildValue("(ls)", (long)(intptr_t)handle, rows));
+  if (r == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  PyObject* seq = PySequence_Fast(r, "predict() did not return a sequence");
+  Py_DECREF(r);
+  if (seq == NULL) {
+    PyErr_Print();
+    return -1;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  int wrote = 0;
+  for (Py_ssize_t i = 0; i < n && wrote < capacity; ++i) {
+    double v = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(seq, i));
+    if (v == -1.0 && PyErr_Occurred()) {
+      Py_DECREF(seq);
+      PyErr_Print();
+      return -1;
+    }
+    out_pctr[wrote++] = v;
+  }
+  Py_DECREF(seq);
+  return wrote;
+}
+
 int XFDestroy(void* handle) {
   if (ensure_interp() != 0) return -1;
   PyObject* r = call("destroy", Py_BuildValue("(l)", (long)(intptr_t)handle));

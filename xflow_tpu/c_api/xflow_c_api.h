@@ -30,6 +30,20 @@ int XFStartTrain(void* handle);
 /* Test AUC from the last XFStartTrain (NaN if not evaluated). */
 double XFGetAUC(void* handle);
 
+/* Load the newest COMMITTED checkpoint under `checkpoint_dir` into an
+ * online predictor for this handle (reshard-on-load; corrupt newer
+ * steps walk back to the previous committed one). Config overrides
+ * applied via XFSetConfig must match the checkpoint's model/hash
+ * config. Returns 0 on success, nonzero on failure. */
+int XFLoadCheckpoint(void* handle, const char* checkpoint_dir);
+
+/* Predict pCTR for newline-separated libffm feature rows (an optional
+ * leading label per row is ignored). Writes up to `capacity` values
+ * into `out_pctr`; returns the number of predictions written, or -1
+ * on error (no loaded checkpoint, malformed row). Predictions come
+ * from the same forward the trainer's evaluate uses. */
+int XFPredict(void* handle, const char* rows, double* out_pctr, int capacity);
+
 /* Release the trainer. */
 int XFDestroy(void* handle);
 

@@ -346,12 +346,52 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online-serving knobs (`xflow serve`, docs/SERVING.md).
+
+    The model/data/train sections still apply at serve time: the model
+    config must match the checkpoint (same contract as `xflow export`),
+    `data.max_nnz`/`log2_slots`/`hash_salt` define the request hash
+    path (a served feature must land in the slot it trained into), and
+    `train.checkpoint_dir`/`checkpoint_format`/`checkpoint_verify`
+    locate and gate what gets loaded.
+    """
+
+    host: str = "127.0.0.1"
+    # TCP port (0 = pick a free one, reported in the ready line;
+    # -1 = no TCP listener — unix_socket only)
+    port: int = 8000
+    # AF_UNIX socket path ("" = off): same HTTP protocol, for colocated
+    # clients (the C API's native embedder) without the TCP stack
+    unix_socket: str = ""
+    # microbatching (serve/coalescer.py): requests queued inside this
+    # window coalesce into ONE padded device batch — the window is the
+    # idle-server latency floor and the busy-server throughput lever
+    window_ms: float = 2.0
+    # rows per device batch = the compiled batch shape (fixed, so the
+    # predict program compiles once); also the per-request row cap
+    max_batch: int = 256
+    # backlog cap in rows; beyond it submits shed load with 503
+    max_queue_rows: int = 8192
+    # hot reload: poll the checkpoint dir for a newer COMMITTED step
+    # this often (serve/runner.CheckpointWatcher); 0 < poll always on
+    reload_poll_s: float = 2.0
+    # kind="serve" telemetry JSONL ("" = off): QPS / batch-fill /
+    # latency windows + reload events (docs/OBSERVABILITY.md)
+    metrics_path: str = ""
+    metrics_every_s: float = 5.0
+    # a request unanswered this long gets 503 (the device wedged)
+    request_timeout_s: float = 30.0
+
+
+@dataclass(frozen=True)
 class Config:
     model: ModelConfig = field(default_factory=ModelConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     data: DataConfig = field(default_factory=DataConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     @property
     def num_slots(self) -> int:

@@ -89,6 +89,20 @@ def _build_config(args) -> "Config":
         pairs["data.log2_slots"] = args.log2_slots
     if getattr(args, "checkpoint_dir", None):
         pairs["train.checkpoint_dir"] = args.checkpoint_dir
+    # serve-only flags (cmd_serve's parser uses serve_* dests so the
+    # launchers' unrelated --port never collides here)
+    for attr, key in (
+        ("serve_port", "serve.port"),
+        ("serve_host", "serve.host"),
+        ("serve_unix_socket", "serve.unix_socket"),
+        ("serve_window_ms", "serve.window_ms"),
+        ("serve_max_batch", "serve.max_batch"),
+        ("serve_poll_s", "serve.reload_poll_s"),
+        ("serve_metrics_path", "serve.metrics_path"),
+    ):
+        v = getattr(args, attr, None)
+        if v is not None:
+            pairs[key] = v
     for item in args.set:
         k, _, v = item.partition("=")
         pairs[k] = v
@@ -146,6 +160,38 @@ def cmd_train(args) -> int:
     if rank == 0:
         print(json.dumps(summary))
     return 0
+
+
+def cmd_serve(args) -> int:
+    """`xflow serve`: online pCTR inference over a committed checkpoint
+    (docs/SERVING.md) — microbatched HTTP/unix-socket serving with hot
+    reload when a newer checkpoint commits. The model/data config must
+    match the checkpoint's (same contract as `xflow export`); pass the
+    training run's --set overrides."""
+    cfg = _build_config(args)
+    if not cfg.train.checkpoint_dir:
+        print("serve: --checkpoint-dir is required", file=sys.stderr)
+        return 2
+    import jax
+
+    from xflow_tpu.parallel.mesh import make_mesh
+    from xflow_tpu.serve.server import serve_main
+
+    mesh = None
+    if not args.no_mesh and len(jax.devices()) > 1:
+        mesh = make_mesh(cfg)
+        if cfg.serve.max_batch % mesh.shape["data"] != 0:
+            print(
+                f"serve: serve.max_batch={cfg.serve.max_batch} must divide "
+                f"by the mesh data axis ({mesh.shape['data']})",
+                file=sys.stderr,
+            )
+            return 2
+    try:
+        return serve_main(cfg, mesh=mesh)
+    except (FileNotFoundError, RuntimeError) as e:
+        print(f"serve: cannot load a checkpoint: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_gen_data(args) -> int:
@@ -284,6 +330,39 @@ def main(argv=None) -> int:
     tr.add_argument("--process-id", type=int, default=None)
     _add_common(tr)
     tr.set_defaults(fn=cmd_train)
+
+    sv = sub.add_parser(
+        "serve",
+        help="online pCTR inference over a committed checkpoint "
+             "(microbatching + hot reload; docs/SERVING.md)",
+    )
+    sv.add_argument("--checkpoint-dir", required=True,
+                    help="run dir holding COMMITTED checkpoints; the newest "
+                         "loads at startup and newer commits hot-reload")
+    sv.add_argument("--model", default=None,
+                    help="model of the checkpoint (lr|fm|mvm|ffm); must match")
+    sv.add_argument("--log2-slots", type=int, default=None)
+    sv.add_argument("--port", dest="serve_port", type=int, default=None,
+                    help="TCP port (default 8000; 0 = pick free, reported in "
+                         "the ready line; -1 = unix socket only)")
+    sv.add_argument("--host", dest="serve_host", default=None)
+    sv.add_argument("--unix-socket", dest="serve_unix_socket", default=None,
+                    help="also (or only) serve HTTP over this AF_UNIX path")
+    sv.add_argument("--window-ms", dest="serve_window_ms", type=float,
+                    default=None,
+                    help="microbatch coalescing window (default 2.0)")
+    sv.add_argument("--max-batch", dest="serve_max_batch", type=int,
+                    default=None,
+                    help="rows per device batch = compiled batch shape "
+                         "(default 256)")
+    sv.add_argument("--poll-s", dest="serve_poll_s", type=float, default=None,
+                    help="hot-reload checkpoint poll interval (default 2.0)")
+    sv.add_argument("--metrics-path", dest="serve_metrics_path", default=None,
+                    help="kind=serve telemetry JSONL (QPS/latency windows + "
+                         "reload events; tools/metrics_report.py reads it)")
+    sv.add_argument("--no-mesh", action="store_true", help="force single-device")
+    _add_common(sv)
+    sv.set_defaults(fn=cmd_serve)
 
     gd = sub.add_parser("gen-data", help="generate synthetic libffm shards")
     gd.add_argument("out_prefix")

@@ -1,0 +1,21 @@
+"""Online serving: sharded inference over committed checkpoints.
+
+`xflow_tpu serve` (launch/cli.py cmd_serve) loads any COMMITTED
+checkpoint — reshard-on-load places the tables onto whatever devices
+serving has — and answers pCTR queries over HTTP / unix socket with
+request microbatching (serve/coalescer.py) and hot model reload
+(serve/runner.py CheckpointWatcher). docs/SERVING.md has the
+architecture and the knob reference.
+"""
+
+from xflow_tpu.serve.coalescer import MicroBatcher, RejectedRequest, assemble_batch
+from xflow_tpu.serve.runner import CheckpointWatcher, ServeRunner, parse_rows
+
+__all__ = [
+    "MicroBatcher",
+    "RejectedRequest",
+    "assemble_batch",
+    "CheckpointWatcher",
+    "ServeRunner",
+    "parse_rows",
+]

@@ -1,0 +1,207 @@
+"""Request microbatching: the coalescing window.
+
+A TPU answers one 256-row padded batch in roughly the time it answers
+one 1-row batch — per-request dispatch wastes the device. The
+MicroBatcher queues concurrent requests and releases them as ONE
+group when either (a) the queued rows reach `max_rows` (size flush) or
+(b) the OLDEST queued request has waited `window_s` (deadline flush) —
+so an idle server adds at most one window of latency and a busy server
+fills its batches. The reference's closest analog is the worker's
+per-minibatch unique-key Pull (`lr_worker.cc:150-165`): amortize the
+parameter-plane round trip over many rows.
+
+Requests stay WHOLE: a group never splits a request across two device
+batches (its rows would otherwise answer at two generations mid-swap).
+A request larger than `max_rows` is rejected at submit — the client
+splits, the server's compiled batch shape stays fixed.
+
+Everything here is socket-free and clock-injectable: the HTTP layer
+(serve/server.py) calls `submit`, the device worker calls `take`, and
+the unit tests (tests/test_serve.py) drive both with a fake clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class RejectedRequest(Exception):
+    """A request the coalescer will not queue. `client_error` carries
+    the HTTP status class explicitly (serve/server.py): True = the
+    CLIENT's mistake (empty/oversized — 400, don't retry unchanged);
+    False = load shedding (backlog full, shutting down — 503, retry
+    later). Either way a visible signal, never a crash."""
+
+    def __init__(self, message: str, client_error: bool = False):
+        super().__init__(message)
+        self.client_error = client_error
+
+
+@dataclass
+class PendingRequest:
+    """One queued request: ragged rows awaiting a device batch."""
+
+    fields: list  # per-row int32 arrays
+    slots: list  # per-row int32 arrays
+    future: Future = field(default_factory=Future)
+    t_submit: float = 0.0
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.slots)
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        max_rows: int,
+        window_s: float,
+        max_queue_rows: int = 8192,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        if max_rows <= 0:
+            raise ValueError(f"max_rows={max_rows}: need >= 1")
+        self.max_rows = int(max_rows)
+        self.window_s = float(window_s)
+        self.max_queue_rows = int(max_queue_rows)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q: deque = deque()
+        self._queued_rows = 0
+        self._closed = False
+
+    @property
+    def queued_rows(self) -> int:
+        with self._lock:
+            return self._queued_rows
+
+    def submit(self, fields_rows: list, slots_rows: list) -> Future:
+        """Queue one request's rows; returns the Future its caller
+        blocks on. Raises RejectedRequest (never queues half a request)
+        when the request is empty/oversized, the backlog is full, or
+        the batcher is closed."""
+        n = len(slots_rows)
+        if n == 0:
+            raise RejectedRequest("request has no rows", client_error=True)
+        if n > self.max_rows:
+            raise RejectedRequest(
+                f"request has {n} rows > serve.max_batch={self.max_rows}; "
+                "split the request",
+                client_error=True,
+            )
+        req = PendingRequest(
+            fields=list(fields_rows), slots=list(slots_rows),
+            t_submit=self._clock(),
+        )
+        with self._lock:
+            if self._closed:
+                raise RejectedRequest("server is shutting down")
+            if self._queued_rows + n > self.max_queue_rows:
+                raise RejectedRequest(
+                    f"queue full ({self._queued_rows} rows backlogged, "
+                    f"limit {self.max_queue_rows}); retry later"
+                )
+            self._q.append(req)
+            self._queued_rows += n
+            self._cv.notify_all()
+        return req.future
+
+    def take(self, timeout: Optional[float] = None) -> Optional[list]:
+        """Block until a group is releasable, then pop and return it
+        ([PendingRequest]). Returns None on timeout with nothing queued,
+        or when closed and drained — the device worker's exit signal.
+
+        Release rule: queued rows >= max_rows (size flush), the oldest
+        request has aged past window_s (deadline flush), or the batcher
+        closed (drain everything pending). The popped group is the
+        longest whole-request prefix fitting max_rows."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._lock:
+            while True:
+                now = self._clock()
+                if self._q:
+                    flush_at = self._q[0].t_submit + self.window_s
+                    if (
+                        self._queued_rows >= self.max_rows
+                        or now >= flush_at
+                        or self._closed
+                    ):
+                        return self._pop_group_locked()
+                    if deadline is not None and now >= deadline:
+                        return None  # caller's timeout: window still open
+                    # sleep until the window deadline (or the caller's
+                    # timeout, or a submit that fills the batch)
+                    wake = flush_at if deadline is None else min(flush_at, deadline)
+                    self._cv.wait(max(wake - now, 0.0))
+                    continue
+                if self._closed:
+                    return None
+                if deadline is not None:
+                    left = deadline - now
+                    if left <= 0:
+                        return None
+                    self._cv.wait(left)
+                else:
+                    self._cv.wait()
+
+    def _pop_group_locked(self) -> list:
+        group = []
+        rows = 0
+        while self._q and rows + self._q[0].num_rows <= self.max_rows:
+            req = self._q.popleft()
+            rows += req.num_rows
+            group.append(req)
+        self._queued_rows -= rows
+        return group
+
+    def close(self) -> None:
+        """Stop accepting; wake the worker so it drains the backlog
+        (every queued future still resolves) and then sees None."""
+        with self._lock:
+            self._closed = True
+            self._cv.notify_all()
+
+
+def assemble_batch(
+    group: list, batch_size: int, max_nnz: int
+) -> tuple[dict, list]:
+    """Pack a group's ragged rows into ONE padded row-major batch.
+
+    Returns (arrays, spans): `arrays` is the {slots, fields, mask,
+    row_mask} dict the predict step consumes — fixed [batch_size,
+    max_nnz] shape so the jitted program compiles ONCE — and `spans`
+    is [(request, start, stop)] mapping each request back to its row
+    slice of the pctr output. Rows longer than max_nnz truncate to a
+    deterministic prefix (the training parser's contract,
+    data/schema.make_batch); padding rows are fully masked.
+    """
+    slots = np.zeros((batch_size, max_nnz), dtype=np.int32)
+    fields = np.zeros((batch_size, max_nnz), dtype=np.int32)
+    mask = np.zeros((batch_size, max_nnz), dtype=np.float32)
+    row_mask = np.zeros((batch_size,), dtype=np.float32)
+    spans = []
+    i = 0
+    for req in group:
+        start = i
+        for rf, rs in zip(req.fields, req.slots):
+            k = min(len(rs), max_nnz)
+            slots[i, :k] = rs[:k]
+            fields[i, :k] = rf[:k]
+            mask[i, :k] = 1.0
+            row_mask[i] = 1.0
+            i += 1
+        spans.append((req, start, i))
+    if i > batch_size:
+        raise ValueError(f"group rows {i} > batch_size {batch_size} (bug)")
+    return (
+        {"slots": slots, "fields": fields, "mask": mask, "row_mask": row_mask},
+        spans,
+    )

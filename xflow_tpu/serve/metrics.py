@@ -1,0 +1,162 @@
+"""Serving telemetry: kind="serve" JSONL windows + reload events.
+
+Plugs into the same registry/appender plumbing training uses
+(xflow_tpu/telemetry.py, xflow_tpu/jsonl.py): every record is stamped
+ts/rank/run_id/gen/world by the shared appender and kind="serve" keys
+the stream, so one run dir can hold training metrics, heartbeats, AND
+serving windows and tools/metrics_report.py tells them apart.
+
+Window records (one per `every_s`, only when traffic flowed) carry the
+serving SLO view: QPS, rows/s, batch-fill ratio (how well the
+coalescer amortizes the device), and the latency decomposition —
+queue-wait (coalescing delay), device (predict step), total
+(submit -> response ready) p50/p99. `generation`/`step` say which
+model answered the window. Event records ({"event": "reload"|
+"reload_failed"|"start"|"final"}) mark the hot-reload timeline.
+docs/OBSERVABILITY.md documents the schema; metrics_report --check
+gates it (all-or-none keys, monotone generation).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from xflow_tpu.jsonl import JsonlAppender
+from xflow_tpu.telemetry import Registry, default_registry
+
+# the key set every serve window record carries (metrics_report --check
+# enforces all-or-none via its SERVE_KEYS copy of this tuple; keep
+# docs/OBSERVABILITY.md in sync)
+SERVE_WINDOW_KEYS = (
+    "requests",
+    "rows",
+    "qps",
+    "rows_per_s",
+    "batches",
+    "batch_fill",
+    "queue_wait_p50_ms",
+    "queue_wait_p99_ms",
+    "device_p50_ms",
+    "device_p99_ms",
+    "total_p50_ms",
+    "total_p99_ms",
+    "window_s",
+    "bad_requests",
+    "generation",
+    "step",
+)
+
+
+class ServeMetrics:
+    """Thread-safe window aggregator -> JSONL sink. `observe_batch`
+    runs on the device-worker thread, `observe_bad_request` on HTTP
+    handler threads, `event` on the watcher thread."""
+
+    def __init__(
+        self,
+        path: str = "",
+        every_s: float = 5.0,
+        batch_size: int = 1,
+        registry: Optional[Registry] = None,
+    ):
+        self._app = JsonlAppender(path, stamp=None)  # lazy rank/run_id
+        self._kind = {"kind": "serve"}
+        self._every = max(float(every_s), 0.05)
+        self._batch_size = max(int(batch_size), 1)
+        self._reg = registry or default_registry()
+        self._lock = threading.Lock()
+        self._win_start = time.perf_counter()
+        self._reset_window_locked()
+
+    def _reset_window_locked(self) -> None:
+        self._requests = 0
+        self._rows = 0
+        self._batches = 0
+        self._bad = 0
+        self._queue_waits: list = []
+        self._device: list = []
+        self._totals: list = []
+
+    # ------------------------------------------------------------ observing
+    def observe_batch(
+        self,
+        n_requests: int,
+        n_rows: int,
+        queue_waits_s: list,
+        device_s: float,
+        totals_s: list,
+    ) -> None:
+        with self._lock:
+            self._requests += n_requests
+            self._rows += n_rows
+            self._batches += 1
+            self._queue_waits.extend(queue_waits_s)
+            self._device.append(device_s)
+            self._totals.extend(totals_s)
+        self._reg.counter("serve.requests").inc(n_requests)
+        self._reg.counter("serve.rows").inc(n_rows)
+        self._reg.counter("serve.batches").inc()
+
+    def observe_bad_request(self) -> None:
+        with self._lock:
+            self._bad += 1
+        self._reg.counter("serve.bad_requests").inc()
+
+    def event(self, name: str, **extra) -> None:
+        """Append an event record immediately (reload timeline)."""
+        self._app.append({**self._kind, "event": name, **extra})
+
+    # ------------------------------------------------------------- flushing
+    def maybe_flush(self, generation: int, step: int, force: bool = False) -> Optional[dict]:
+        """Emit a window record when the window elapsed (or `force`) and
+        traffic flowed; returns the record (tests) or None."""
+        now = time.perf_counter()
+        with self._lock:
+            elapsed = now - self._win_start
+            if not force and elapsed < self._every:
+                return None
+            if self._batches == 0 and self._bad == 0:
+                self._win_start = now  # idle window: emit nothing
+                return None
+            pct = lambda xs, q: (
+                round(float(np.percentile(np.asarray(xs) * 1e3, q)), 3)
+                if xs
+                else None
+            )
+            rec = {
+                **self._kind,
+                "requests": self._requests,
+                "rows": self._rows,
+                "qps": round(self._requests / max(elapsed, 1e-9), 2),
+                "rows_per_s": round(self._rows / max(elapsed, 1e-9), 1),
+                "batches": self._batches,
+                "batch_fill": round(
+                    self._rows / max(self._batches * self._batch_size, 1), 4
+                ),
+                "queue_wait_p50_ms": pct(self._queue_waits, 50),
+                "queue_wait_p99_ms": pct(self._queue_waits, 99),
+                "device_p50_ms": pct(self._device, 50),
+                "device_p99_ms": pct(self._device, 99),
+                "total_p50_ms": pct(self._totals, 50),
+                "total_p99_ms": pct(self._totals, 99),
+                "window_s": round(elapsed, 3),
+                "bad_requests": self._bad,
+                "generation": int(generation),
+                "step": int(step),
+            }
+            self._reset_window_locked()
+            self._win_start = now
+        self._app.append(rec)
+        self._reg.gauge("serve.qps").set(rec["qps"])
+        if rec["batches"]:
+            self._reg.gauge("serve.batch_fill").set(rec["batch_fill"])
+        return rec
+
+    def close(self, generation: int = -1, step: int = -1) -> None:
+        self.maybe_flush(generation, step, force=True)
+        self._app.append({**self._kind, "event": "final"})
+        self._app.close()

@@ -1,0 +1,311 @@
+"""The serve runner: checkpoint-backed online prediction with hot reload.
+
+Loads any COMMITTED checkpoint into device-resident tables and answers
+pCTR batches through the SAME jitted forward the trainer's evaluate
+uses (models/predict.py — one function, so offline eval and online
+serving cannot drift). Three properties carry the design:
+
+- **Reshard-on-load** (PR 5): the restore paths place every leaf onto
+  whatever devices serving has, so an N-rank training checkpoint loads
+  on a 1-chip serving box or a serving mesh without conversion. The
+  template the restore fills is built with `jax.eval_shape` — shapes
+  and shardings only, no throwaway allocation — and for npz the
+  optimizer state is skipped entirely (serving never reads n/z; the
+  tables-only template makes the restore read 1/3 of the bytes).
+
+- **Hot reload, double-buffered**: a background CheckpointWatcher polls
+  the run dir for a NEWER committed step and loads it OFF the request
+  path; the swap is one reference assignment (`self._gen = new`).
+  In-flight requests captured the previous Generation object and
+  finish on the old tables; new requests see the new one. No lock is
+  held across a predict, nothing blocks, nothing drops. Every response
+  carries the generation + checkpoint step that answered it.
+
+- **Bad checkpoint ≠ outage**: a reload that fails (corrupt file,
+  digest mismatch, torn copy) logs + emits a `reload_failed` event and
+  KEEPS SERVING the current generation — restore_any's walk-back means
+  a corrupt newest step quietly restores the previous committed one,
+  and the runner refuses to "reload" backwards to the step it already
+  serves (docs/SERVING.md failure matrix).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from xflow_tpu.config import Config
+
+
+class BadRequest(ValueError):
+    """A request the server answers with 400: malformed row, no
+    parseable features. The serving analog of the data pipeline's
+    bad-record quarantine (data/pipeline.py): reject and count the
+    record, never crash the process."""
+
+
+def parse_rows(rows: list, dcfg) -> tuple[list, list]:
+    """Parse request rows (libffm feature lists, optional leading label
+    ignored) into per-row (fields, slots) int32 arrays using the SAME
+    hash path training used (data/libffm.parse_line), so a served
+    feature lands in the same table slot it trained into.
+
+    Raises BadRequest on a non-string row or a row with zero parseable
+    features — the quarantine philosophy: a row whose features ALL
+    failed to parse must not silently predict the bias."""
+    from xflow_tpu.data.libffm import parse_line
+
+    fields_rows, slots_rows = [], []
+    for i, row in enumerate(rows):
+        if not isinstance(row, str):
+            raise BadRequest(f"row {i}: expected a string, got {type(row).__name__}")
+        # no tab = features-only (the serving shape); a tab means the
+        # client sent a full libffm line and the label is ignored
+        line = row if "\t" in row else "0\t" + row
+        parsed = parse_line(line, dcfg.log2_slots, dcfg.hash_salt)
+        if parsed is None or parsed[2].size == 0:
+            raise BadRequest(f"row {i}: no parseable field:feature tokens in {row!r}")
+        _, f, s = parsed
+        fields_rows.append(f)
+        slots_rows.append(s)
+    return fields_rows, slots_rows
+
+
+@dataclass
+class Generation:
+    """One loaded model generation: the serving tables + provenance."""
+
+    tables: dict
+    step: int
+    gen: int
+    loaded_ts: float = field(default_factory=time.time)
+
+
+class ServeRunner:
+    """Checkpoint-backed pCTR prediction (single process; the serving
+    mesh is whatever local devices exist — pass `mesh` to pjit-shard
+    the tables over them, None for single-device)."""
+
+    def __init__(self, cfg: Config, mesh=None):
+        from xflow_tpu.models import get_model
+        from xflow_tpu.optim import get_optimizer
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.model = get_model(cfg.model.name)
+        self._optimizer = get_optimizer(cfg.optim.name)
+        self._gen: Optional[Generation] = None
+        self._gen_counter = 0
+        self._reload_lock = threading.Lock()  # one loader at a time
+        if mesh is not None:
+            from xflow_tpu.parallel.mesh import batch_sharding
+            from xflow_tpu.parallel.train_step import make_sharded_eval_step
+
+            self._predict_step = make_sharded_eval_step(self.model, cfg, mesh)
+            bsh = batch_sharding(mesh)
+            import jax
+
+            self._put = lambda arrays: {
+                k: jax.device_put(np.asarray(v), bsh[k]) for k, v in arrays.items()
+            }
+        else:
+            from xflow_tpu.models.predict import make_predict_fn
+
+            self._predict_step = make_predict_fn(self.model, cfg)
+            import jax
+
+            self._put = jax.device_put
+
+    # ------------------------------------------------------------- loading
+    def _template(self):
+        """An allocation-free restore template: the state's shapes (and
+        shardings, on a mesh) as ShapeDtypeStructs. npz skips the
+        optimizer state (restore() fills exactly what the template
+        asks for); orbax restores the full tree (its tree-structure
+        contract) and the opt arrays drop right after."""
+        import jax
+
+        from xflow_tpu.train.state import TrainState, init_state
+
+        abstract = jax.eval_shape(
+            lambda: init_state(self.model, self._optimizer, self.cfg)
+        )
+        if self.mesh is not None:
+            from xflow_tpu.parallel.mesh import state_shardings
+
+            sh = state_shardings(abstract, self.mesh)
+            abstract = jax.tree.map(
+                lambda s, shd: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=shd),
+                abstract,
+                sh,
+            )
+        if self.cfg.train.checkpoint_format != "orbax":
+            abstract = TrainState(
+                tables=abstract.tables, opt_state={}, step=abstract.step
+            )
+        return abstract
+
+    @property
+    def generation(self) -> Optional[Generation]:
+        return self._gen
+
+    @property
+    def step(self) -> int:
+        return self._gen.step if self._gen else -1
+
+    def latest_committed_step(self) -> Optional[int]:
+        from xflow_tpu.train import checkpoint as ckpt
+
+        cdir = self.cfg.train.checkpoint_dir
+        if self.cfg.train.checkpoint_format == "orbax":
+            return ckpt.latest_orbax_step(cdir)
+        return ckpt.latest_step(cdir)
+
+    def load(self) -> Generation:
+        """Load the newest committed checkpoint (walk-back on corrupt
+        newer steps, digest-verified per train.checkpoint_verify) and
+        swap it in. Raises when no checkpoint loads at all — at
+        STARTUP that is fatal; the watcher wraps reloads so a later
+        failure never kills serving."""
+        from xflow_tpu.train import checkpoint as ckpt
+
+        with self._reload_lock:
+            state, step = ckpt.restore_any(
+                self.cfg.train.checkpoint_dir,
+                self._template(),
+                fmt=self.cfg.train.checkpoint_format,
+                verify=self.cfg.train.checkpoint_verify,
+            )
+            if self._gen is not None and step <= self._gen.step:
+                # restore_any walked back to (or re-found) what we
+                # already serve — swapping would REGRESS the generation
+                raise RuntimeError(
+                    f"newest loadable checkpoint is step {step}, already "
+                    f"serving step {self._gen.step} — keeping the current "
+                    "generation"
+                )
+            self._gen_counter += 1
+            gen = Generation(
+                tables=state.tables, step=int(step), gen=self._gen_counter
+            )
+            # the swap: one reference assignment — in-flight requests
+            # hold the old Generation and finish on the old tables
+            self._gen = gen
+            return gen
+
+    def maybe_reload(self) -> Optional[Generation]:
+        """Reload iff a COMMITTED step newer than the serving one
+        exists. Returns the new Generation, or None (nothing newer, or
+        the reload failed — logged, old generation keeps serving)."""
+        try:
+            latest = self.latest_committed_step()
+            if latest is None or (self._gen and latest <= self._gen.step):
+                return None
+            gen = self.load()
+            print(
+                f"serve: hot reload: now serving step {gen.step} "
+                f"(generation {gen.gen})",
+                file=sys.stderr,
+            )
+            return gen
+        except Exception as e:  # noqa: BLE001 — ANY reload failure
+            # (torn copy, digest mismatch, walk-back to the serving
+            # step) keeps the current generation serving
+            print(
+                f"serve: reload failed ({type(e).__name__}: {e}); "
+                f"keeping generation {self._gen.gen if self._gen else '?'} "
+                f"(step {self.step})",
+                file=sys.stderr,
+            )
+            return None
+
+    # ----------------------------------------------------------- predicting
+    def predict(self, arrays: dict) -> tuple[np.ndarray, Generation]:
+        """One device batch: row-major {slots, fields, mask, row_mask}
+        -> (pctr [B] host array, the Generation that answered). The
+        generation is captured ONCE before dispatch so a concurrent
+        swap cannot split a batch across models."""
+        gen = self._gen
+        if gen is None:
+            raise RuntimeError("no checkpoint loaded; call load() first")
+        p = self._predict_step(gen.tables, self._put(arrays))
+        return np.asarray(p), gen
+
+    def predict_rows(self, rows: list) -> tuple[np.ndarray, Generation]:
+        """Convenience (C API / tests): parse + pad + predict a list of
+        libffm feature rows, chunking by serve.max_batch so the compiled
+        batch shape stays fixed. Returns (pctr [len(rows)], generation)."""
+        from xflow_tpu.serve.coalescer import PendingRequest, assemble_batch
+
+        fields_rows, slots_rows = parse_rows(rows, self.cfg.data)
+        B = self.cfg.serve.max_batch
+        out = np.empty((len(rows),), np.float32)
+        gen = None
+        for lo in range(0, len(rows), B):
+            req = PendingRequest(
+                fields=fields_rows[lo : lo + B], slots=slots_rows[lo : lo + B]
+            )
+            arrays, _ = assemble_batch([req], B, self.cfg.data.max_nnz)
+            p, gen = self.predict(arrays)
+            out[lo : lo + req.num_rows] = p[: req.num_rows]
+        return out, gen
+
+
+class CheckpointWatcher(threading.Thread):
+    """Polls the checkpoint dir every `poll_s` for a newer COMMITTED
+    step and hot-reloads it off the request path. `on_reload(gen)` /
+    `on_failed()` hooks feed the serve telemetry stream."""
+
+    def __init__(
+        self,
+        runner: ServeRunner,
+        poll_s: float = 2.0,
+        on_reload=None,
+        on_failed=None,
+    ):
+        super().__init__(daemon=True, name="xflow-serve-watcher")
+        self._runner = runner
+        self._poll = max(float(poll_s), 0.05)
+        self._stop_evt = threading.Event()
+        self._on_reload = on_reload
+        self._on_failed = on_failed
+        self._failed_step = None  # newest step that failed to load:
+        # retry only when a DIFFERENT step commits — a permanently
+        # corrupt checkpoint must not re-read the whole previous
+        # checkpoint from disk (and spam reload_failed) every poll
+        self.reloads = 0
+        self.failures = 0
+
+    def run(self) -> None:
+        while not self._stop_evt.wait(self._poll):
+            try:
+                latest = self._runner.latest_committed_step()
+            except Exception:
+                continue
+            if (
+                latest is None
+                or latest <= self._runner.step
+                or latest == self._failed_step
+            ):
+                continue
+            gen = self._runner.maybe_reload()
+            if gen is not None:
+                self._failed_step = None
+                self.reloads += 1
+                if self._on_reload:
+                    self._on_reload(gen)
+            else:
+                self._failed_step = latest
+                self.failures += 1
+                if self._on_failed:
+                    self._on_failed()
+
+    def close(self) -> None:
+        self._stop_evt.set()
+        if self.is_alive():
+            self.join(timeout=10.0)

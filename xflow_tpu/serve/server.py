@@ -1,0 +1,361 @@
+"""HTTP / unix-socket front end + the device worker loop.
+
+Request path (docs/SERVING.md):
+
+    HTTP handler thread: parse JSON -> parse rows (same hash path as
+      training) -> MicroBatcher.submit -> block on the request Future
+    device worker thread: MicroBatcher.take (coalescing window) ->
+      assemble ONE padded batch -> ServeRunner.predict -> scatter pctr
+      slices + generation provenance back to every request's Future
+
+One device batch per coalescing window, whatever the concurrency — the
+microbatching contract. The handler threads only parse and wait; the
+single worker thread owns the device, so predict calls never interleave
+and the jitted program compiles exactly once (fixed [max_batch,
+max_nnz] shape).
+
+Failure semantics: malformed body/rows -> 400 with the reason (the
+quarantine philosophy — reject the record, never crash the server);
+backlog full / shutdown -> 503 (load shedding is explicit); an
+unexpected predict error fails ONLY the futures of that batch (500),
+the worker keeps going. `GET /healthz` reports generation/step;
+`GET /stats` snapshots the telemetry registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from xflow_tpu.config import Config
+from xflow_tpu.serve.coalescer import MicroBatcher, RejectedRequest, assemble_batch
+from xflow_tpu.serve.metrics import ServeMetrics
+from xflow_tpu.serve.runner import BadRequest, CheckpointWatcher, ServeRunner, parse_rows
+
+
+class ServeApp:
+    """Wires runner + batcher + metrics + the device worker thread.
+    Socket-free by itself (tests drive `handle_predict` directly); the
+    HTTP servers below call into it."""
+
+    def __init__(self, cfg: Config, runner: ServeRunner, metrics: Optional[ServeMetrics] = None):
+        self.cfg = cfg
+        self.runner = runner
+        scfg = cfg.serve
+        self.metrics = metrics or ServeMetrics(
+            scfg.metrics_path, every_s=scfg.metrics_every_s, batch_size=scfg.max_batch
+        )
+        self.batcher = MicroBatcher(
+            max_rows=scfg.max_batch,
+            window_s=scfg.window_ms / 1e3,
+            max_queue_rows=scfg.max_queue_rows,
+        )
+        self._timeout_s = scfg.request_timeout_s
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._worker_loop, daemon=True, name="xflow-serve-device"
+        )
+        self.t_start = time.perf_counter()
+
+    def start(self) -> None:
+        self._worker.start()
+
+    # ------------------------------------------------------- device worker
+    def _worker_loop(self) -> None:
+        cfg = self.cfg
+        while True:
+            group = self.batcher.take(timeout=0.1)
+            if group is None:
+                if self._stop.is_set():
+                    return
+                # idle tick: windows still flush on schedule
+                gen = self.runner.generation
+                if gen is not None:
+                    self.metrics.maybe_flush(gen.gen, gen.step)
+                continue
+            t_batch = time.perf_counter()
+            try:
+                arrays, spans = assemble_batch(
+                    group, cfg.serve.max_batch, cfg.data.max_nnz
+                )
+                # predict's np.asarray readback IS the device sync: the
+                # worker (not the handler threads) pays the batch's
+                # device time, shared by all its requests
+                p, gen = self.runner.predict(arrays)
+            except Exception as e:  # noqa: BLE001 — fail THIS batch's
+                # futures, keep the worker alive for the next window
+                for req in group:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                continue
+            t_done = time.perf_counter()
+            device_s = t_done - t_batch
+            queue_waits, totals = [], []
+            n_rows = 0
+            for req, lo, hi in spans:
+                queue_waits.append(t_batch - req.t_submit)
+                totals.append(t_done - req.t_submit)
+                n_rows += hi - lo
+                req.future.set_result(
+                    {
+                        "pctr": [float(x) for x in p[lo:hi]],
+                        "generation": gen.gen,
+                        "step": gen.step,
+                        "queue_ms": round((t_batch - req.t_submit) * 1e3, 3),
+                        "total_ms": round((t_done - req.t_submit) * 1e3, 3),
+                    }
+                )
+            self.metrics.observe_batch(
+                len(group), n_rows, queue_waits, device_s, totals
+            )
+            self.metrics.maybe_flush(gen.gen, gen.step)
+
+    # ----------------------------------------------------------- app logic
+    def handle_predict(self, body: bytes) -> tuple[int, dict]:
+        """(http_status, response dict) for one POST /predict body:
+        {"rows": ["field:feat field:feat ...", ...]}."""
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            self.metrics.observe_bad_request()
+            return 400, {"error": f"body is not JSON: {e}"}
+        rows = payload.get("rows") if isinstance(payload, dict) else None
+        if not isinstance(rows, list) or not rows:
+            self.metrics.observe_bad_request()
+            return 400, {"error": 'expected {"rows": [<libffm feature row>, ...]}'}
+        try:
+            fields_rows, slots_rows = parse_rows(rows, self.cfg.data)
+        except BadRequest as e:
+            self.metrics.observe_bad_request()
+            return 400, {"error": str(e)}
+        try:
+            fut = self.batcher.submit(fields_rows, slots_rows)
+        except RejectedRequest as e:
+            self.metrics.observe_bad_request()
+            # oversized request is the CLIENT's error; backlog/shutdown
+            # is load shedding (the exception carries the class)
+            return (400 if e.client_error else 503), {"error": str(e)}
+        try:
+            return 200, fut.result(timeout=self._timeout_s)
+        except FutureTimeout:
+            return 503, {"error": f"timed out after {self._timeout_s}s"}
+        except Exception as e:  # noqa: BLE001 — a failed batch reports
+            # its reason to the client instead of a hung connection
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    def health(self) -> dict:
+        gen = self.runner.generation
+        return {
+            "ok": gen is not None,
+            "generation": gen.gen if gen else 0,
+            "step": gen.step if gen else -1,
+            "queued_rows": self.batcher.queued_rows,
+            "uptime_s": round(time.perf_counter() - self.t_start, 3),
+        }
+
+    def stats(self) -> dict:
+        from xflow_tpu.telemetry import default_registry
+
+        return {**self.health(), "registry": default_registry().snapshot()}
+
+    def close(self) -> None:
+        """Graceful: stop intake, drain the backlog (every queued
+        future resolves), stop the worker, flush metrics."""
+        self.batcher.close()
+        self._stop.set()
+        if self._worker.is_alive():
+            self._worker.join(timeout=30.0)
+        gen = self.runner.generation
+        self.metrics.close(gen.gen if gen else -1, gen.step if gen else -1)
+
+
+def _make_handler(app: ServeApp):
+    class Handler(BaseHTTPRequestHandler):
+        # serving answers many short requests; HTTP/1.1 keep-alive makes
+        # the loadgen's closed loop connection-reuse instead of
+        # connect-per-request
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, status: int, payload: dict) -> None:
+            data = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            if self.path != "/predict":
+                self._reply(404, {"error": f"no such endpoint {self.path!r}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                n = 0
+            body = self.rfile.read(n) if n > 0 else b""
+            status, payload = app.handle_predict(body)
+            self._reply(status, payload)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                h = app.health()
+                self._reply(200 if h["ok"] else 503, h)
+            elif self.path == "/stats":
+                self._reply(200, app.stats())
+            else:
+                self._reply(404, {"error": f"no such endpoint {self.path!r}"})
+
+        def log_message(self, fmt, *args):  # quiet: telemetry JSONL is
+            pass  # the record of traffic, not per-request stderr lines
+
+        def address_string(self):
+            # AF_UNIX client addresses are ''/b'' — BaseHTTPRequestHandler
+            # indexes client_address[0], which only works for AF_INET
+            try:
+                return super().address_string()
+            except (IndexError, TypeError):
+                return "unix"
+
+    return Handler
+
+
+class _QuietDisconnects:
+    """A client dropping its keep-alive connection mid-read is normal
+    load-balancer/loadgen behavior, not a server error — suppress the
+    default stderr traceback for exactly that; real errors still print."""
+
+    def handle_error(self, request, client_address):
+        import sys as _sys
+
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
+
+class _TCPHTTPServer(_QuietDisconnects, ThreadingHTTPServer):
+    daemon_threads = True
+
+
+def make_http_server(app: ServeApp, host: str, port: int) -> ThreadingHTTPServer:
+    """TCP server (port 0 = pick free; read .server_address back)."""
+    return _TCPHTTPServer((host, port), _make_handler(app))
+
+
+class _UnixHTTPServer(
+    _QuietDisconnects, socketserver.ThreadingMixIn, socketserver.TCPServer
+):
+    """HTTP over AF_UNIX: same handler, same wire protocol — the
+    colocated-client path (the reference's C API embeds in a native
+    ranking server; a unix socket skips the TCP stack for it)."""
+
+    address_family = socket.AF_UNIX
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def server_bind(self):
+        # a stale socket file from a dead server would EADDRINUSE
+        if os.path.exists(self.server_address):
+            os.unlink(self.server_address)
+        super().server_bind()
+
+    def get_request(self):
+        request, _ = super().get_request()
+        # BaseHTTPRequestHandler formats client_address[0]; give it a
+        # stable shape for unix peers
+        return request, ("unix", 0)
+
+
+def make_unix_server(app: ServeApp, path: str) -> _UnixHTTPServer:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    return _UnixHTTPServer(path, _make_handler(app))
+
+
+def serve_main(cfg: Config, mesh=None, ready_out=None) -> int:
+    """The `xflow serve` body: load -> watch -> serve until SIGTERM/
+    SIGINT. `ready_out` (a file object; default stdout) gets ONE JSON
+    line once the sockets are listening — scripts wait on it and read
+    the bound port back (serve.port=0 picks a free one)."""
+    import signal
+    import sys
+
+    runner = ServeRunner(cfg, mesh=mesh)
+    gen = runner.load()  # startup: no checkpoint IS fatal
+    app = ServeApp(cfg, runner)
+    app.metrics.event("start", generation=gen.gen, step=gen.step)
+    watcher = CheckpointWatcher(
+        runner,
+        poll_s=cfg.serve.reload_poll_s,
+        on_reload=lambda g: app.metrics.event(
+            "reload", generation=g.gen, step=g.step
+        ),
+        on_failed=lambda: app.metrics.event("reload_failed"),
+    )
+    app.start()
+    watcher.start()
+
+    servers = []
+    threads = []
+    if cfg.serve.port >= 0:
+        http = make_http_server(app, cfg.serve.host, cfg.serve.port)
+        servers.append(http)
+    if cfg.serve.unix_socket:
+        servers.append(make_unix_server(app, cfg.serve.unix_socket))
+    if not servers:
+        print("serve: nothing to listen on (serve.port=-1 and no "
+              "serve.unix_socket)", file=sys.stderr)
+        return 2
+    for srv in servers:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        threads.append(t)
+
+    ready = {
+        "serving": True,
+        "step": gen.step,
+        "generation": gen.gen,
+        "pid": os.getpid(),
+    }
+    if cfg.serve.port >= 0:
+        ready["host"], ready["port"] = servers[0].server_address[:2]
+    if cfg.serve.unix_socket:
+        ready["unix_socket"] = cfg.serve.unix_socket
+    out = ready_out or sys.stdout
+    print(json.dumps(ready), file=out, flush=True)
+
+    stop = threading.Event()
+    prev = {}
+
+    def on_signal(signum, frame):
+        stop.set()
+        for s, h in prev.items():
+            signal.signal(s, h)  # second signal kills normally
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        prev[s] = signal.signal(s, on_signal)
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        print("serve: shutting down (draining queued requests)", file=sys.stderr)
+        for srv in servers:
+            srv.shutdown()
+        watcher.close()
+        app.close()
+        for srv in servers:
+            srv.server_close()
+        if cfg.serve.unix_socket and os.path.exists(cfg.serve.unix_socket):
+            try:
+                os.unlink(cfg.serve.unix_socket)
+            except OSError:
+                pass
+    return 0

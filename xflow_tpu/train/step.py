@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from xflow_tpu.config import Config
-from xflow_tpu.metrics import binary_logloss_from_logits, reference_pctr
+from xflow_tpu.metrics import binary_logloss_from_logits
 from xflow_tpu.models.base import Model
 from xflow_tpu.optim.base import Optimizer
 from xflow_tpu.train.state import TrainState
@@ -363,9 +363,11 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
 
 
 def make_eval_step(model: Model, cfg: Config, jit: bool = True) -> Callable:
-    """Returns eval_step(tables, batch_arrays) -> pctr [B] (reference-clamped σ)."""
+    """Returns eval_step(tables, batch_arrays) -> pctr [B].
 
-    def eval_step(tables, batch: dict):
-        return reference_pctr(model.forward(tables, batch, cfg))
+    Delegates to the ONE shared pctr forward (models/predict.py
+    make_predict_fn) — the same function the serve runner compiles, so
+    offline eval and online serving cannot drift."""
+    from xflow_tpu.models.predict import make_predict_fn
 
-    return jax.jit(eval_step) if jit else eval_step
+    return make_predict_fn(model, cfg, jit=jit)
