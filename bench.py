@@ -28,6 +28,29 @@ import numpy as np
 PER_CHIP_TARGET = 50_000_000 / 64  # north-star pod target / chips
 
 
+def stage_row_batches(rng, num_slots: int, num_fields: int, K: int, B: int,
+                      F: int, with_slots: bool = True,
+                      with_fields: bool = True) -> dict:
+    """Host-staged [K, B, F] row-major batch arrays — the pre-staged
+    device-bench harness shape, shared with tools/step_decompose.py so
+    the two harnesses measure the same data distribution. The flags
+    skip draws a caller replaces anyway (generating ~64 MB at the CLI
+    shape only to throw it away): bench's main() takes slots
+    per-distribution from `draw_slots` (zipf/uniform), and the MVM/FFM
+    exclusive-fields shape uses one feature per field."""
+    out = {}
+    if with_slots:
+        out["slots"] = rng.integers(0, num_slots, (K, B, F)).astype(np.int32)
+    if with_fields:
+        out["fields"] = rng.integers(0, num_fields, (K, B, F)).astype(np.int32)
+    out.update({
+        "mask": (rng.random((K, B, F)) < 0.6).astype(np.float32),
+        "labels": (rng.random((K, B)) < 0.4).astype(np.float32),
+        "row_mask": np.ones((K, B), np.float32),
+    })
+    return out
+
+
 def measure_e2e(args, model: str, rows: int) -> float:
     """End-to-end trainer throughput: libffm file on disk → C++ parser →
     (sorted plan in the prefetch thread) → jitted device step. This is
@@ -170,6 +193,13 @@ def main() -> int:
         return bench_e2e(args)
 
     zipf_slots_cache = {}
+    # compile accounting (telemetry.CompileRecorder): each model's
+    # K-step program stamps its compile time and cost analysis; the
+    # headline's lands in the JSON record so BENCH_r*/BENCH_SCALE
+    # datapoints carry cost context, not just throughput. First bench
+    # of a model wins (companion shapes — s24/bf16 — would overwrite
+    # the CLI-shape cost the record describes).
+    cost_by_model: dict = {}
 
     def bench_model(name: str, dists, dup_fields: bool = False,
                     log2_slots: int = 0, batch: int = 0, nnz: int = 0,
@@ -218,20 +248,26 @@ def main() -> int:
         cfg = override(Config(), **overrides)
         model, opt = get_model(name), get_optimizer("ftrl")
         step = make_train_step(model, opt, cfg, jit=False)
-        mask_np = (rng.random((K, B_, F_)) < 0.6).astype(np.float32)
-        if name in ("mvm", "ffm") and not dup_fields:
+        # staging shared with tools/step_decompose.py (same harness,
+        # same distribution); MVM/FFM's exclusive-fields shape uses one
+        # feature per field instead of random fields, so that draw is
+        # skipped too
+        exclusive = name in ("mvm", "ffm") and not dup_fields
+        staged = stage_row_batches(rng, cfg.num_slots, cfg.model.num_fields,
+                                   K, B_, F_, with_slots=False,
+                                   with_fields=not exclusive)
+        mask_np = staged["mask"]
+        if exclusive:
             fields_host = np.broadcast_to(
                 np.arange(F_, dtype=np.int32), (K, B_, F_)
             ).copy()
         else:
-            fields_host = rng.integers(
-                0, cfg.model.num_fields, (K, B_, F_)
-            ).astype(np.int32)
+            fields_host = staged["fields"]
         common = {
             "fields": jnp.asarray(fields_host),
             "mask": jnp.asarray(mask_np),
-            "labels": jnp.asarray((rng.random((K, B_)) < 0.4).astype(np.float32)),
-            "row_mask": jnp.ones((K, B_), jnp.float32),
+            "labels": jnp.asarray(staged["labels"]),
+            "row_mask": jnp.asarray(staged["row_mask"]),
         }
 
         def make_batches(dist: str) -> dict:
@@ -325,12 +361,17 @@ def main() -> int:
 
             return jax.lax.scan(body, state, batches)
 
+        from xflow_tpu.telemetry import CompileRecorder
+
+        crec = CompileRecorder()
+        run_k = crec.wrap(f"bench.{name}", run_k_steps)
+
         rates = {}
         for dist in dists:
             state = init_state(model, opt, cfg)
             batches = make_batches(dist)
             # warmup (compiles on the first dist; cache hit afterwards)
-            state, losses = run_k_steps(state, batches)
+            state, losses = run_k(state, batches)
             _ = float(losses[-1])  # host read = hard sync
             times = []
             # companion runs (non-headline model or zipf) use fewer
@@ -343,7 +384,7 @@ def main() -> int:
             )
             for _ in range(reps):
                 t0 = time.perf_counter()
-                state, losses = run_k_steps(state, batches)
+                state, losses = run_k(state, batches)
                 _ = float(losses[-1])
                 times.append(time.perf_counter() - t0)
             best = min(times)
@@ -354,6 +395,14 @@ def main() -> int:
                 file=sys.stderr,
             )
             rates[dist] = K * B_ / best
+        info = crec.latest(f"bench.{name}")
+        if info and info.get("flops"):
+            cost_by_model.setdefault(name, {
+                "compile_time_s": info["compile_time_s"],
+                "flops": info["flops"],
+                "bytes_accessed": info.get("bytes_accessed"),
+                "examples_per_call": K * B_,  # one call = K steps x B_ rows
+            })
         return rates
 
     kernel_parity = None
@@ -482,6 +531,17 @@ def main() -> int:
             record["e2e_fm_vs_baseline"] = round(e2e_rate / PER_CHIP_TARGET, 3)
     if kernel_parity is not None:
         record["kernel_parity"] = kernel_parity
+    # compile/cost context for the headline model (CompileRecorder):
+    # per-example model FLOPs and bytes accessed are the roofline
+    # numerators tools/perf_ledger.py converts the pod target with
+    # (docs/PERF.md "Measured roofline")
+    cost = cost_by_model.get(headline)
+    if cost:
+        ex = cost["examples_per_call"]
+        record["compile_time_s"] = round(cost["compile_time_s"], 3)
+        record["flops_per_example"] = round(cost["flops"] / ex, 2)
+        if cost.get("bytes_accessed"):
+            record["bytes_per_example"] = round(cost["bytes_accessed"] / ex, 2)
     # wall clock for trajectory correlation only; all durations above are
     # time.perf_counter() (monotonic — wall clock jumps under NTP slew)
     record["ts"] = round(time.time(), 3)

@@ -69,6 +69,12 @@ WINDOW_KEYS = (
 # .HealthMonitor.window_record); --check enforces all-or-none too
 HEALTH_KEYS = ("grad_norm", "update_norm", "param_norm", "loss_ema")
 STAMP_KEYS = ("ts", "rank", "run_id")
+# the key set every kind="compile" record carries (telemetry
+# .CompileRecorder.record — docs/OBSERVABILITY.md "Compile accounting");
+# --check enforces presence, a positive compile time, and the
+# exactly-once rule: the same (program, sig) never compiles twice in
+# one stream (a recompile means a jit cache is thrashing)
+COMPILE_KEYS = ("program", "sig", "compile_time_s", "flops", "bytes_accessed")
 # the key set every kind="serve" window record carries (serve/metrics
 # .ServeMetrics.maybe_flush — SERVE_WINDOW_KEYS there is the writer's
 # copy); --check enforces all-or-none plus monotone model generation
@@ -161,6 +167,16 @@ def serve_streams(streams: dict) -> dict:
         for (rid, rank, kind, gen), recs in streams.items()
         if kind == "serve"
     }
+
+
+def compile_records(streams: dict, run_id: str = "") -> list[dict]:
+    """Every kind="compile" record (optionally one run's), in file
+    order — the CompileRecorder's per-program compile accounting."""
+    out = []
+    for (rid, _rank, kind, _gen), recs in sorted(streams.items(), key=str):
+        if kind == "compile" and (not run_id or rid == run_id):
+            out.extend(recs)
+    return out
 
 
 def _finite(x) -> bool:
@@ -337,6 +353,8 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         last_model_gen = -1  # serve streams: the model generation a
         # record answered with must never regress (hot reload only
         # moves forward; a regression means a swap raced or went back)
+        seen_programs: dict = {}  # compile streams: (program, sig) ->
+        # record index — the exactly-once recompile gate
         for i, rec in enumerate(records, 1):
             for key in STAMP_KEYS:
                 if key not in rec:
@@ -385,6 +403,28 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                     f"{tag}: record {i} is neither a step heartbeat nor "
                     "an event"
                 )
+            if kind == "compile":
+                c_missing = [k for k in COMPILE_KEYS if k not in rec]
+                if c_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks compile keys {c_missing}"
+                    )
+                    continue
+                if not _finite(rec["compile_time_s"]) or rec["compile_time_s"] <= 0:
+                    problems.append(
+                        f"{tag}: record {i} ({rec['program']!r}) has "
+                        "non-positive compile_time_s"
+                    )
+                prog_key = (rec["program"], rec["sig"])
+                if prog_key in seen_programs:
+                    problems.append(
+                        f"{tag}: program {rec['program']!r} sig "
+                        f"{rec['sig']} compiled twice (records "
+                        f"{seen_programs[prog_key]} and {i}) — each "
+                        "program compiles exactly once per run"
+                    )
+                else:
+                    seen_programs[prog_key] = i
             if kind == "serve":
                 s_present = [k for k in SERVE_KEYS if k in rec]
                 if "event" in rec:
@@ -512,6 +552,16 @@ def bench_record(streams: dict) -> dict:
         if aucs:
             rec["auc"] = round(max(aucs), 6)
             break
+    # compile context (telemetry.CompileRecorder): total compile
+    # seconds and program count, so a BENCH datapoint carries the
+    # cost-accounting trail alongside its throughput
+    comps = compile_records(streams, run_id=newest)
+    if comps:
+        rec["compiled_programs"] = len(comps)
+        rec["compile_time_s"] = round(
+            sum(c["compile_time_s"] for c in comps
+                if _finite(c.get("compile_time_s"))), 3
+        )
     return rec
 
 
@@ -563,6 +613,44 @@ def serve_bench_record(streams: dict) -> dict:
         "reloads": int(sum(s["reloads"] for s in rows.values())),
         "generations": gens,
     }
+
+
+def render_compile_table(streams: dict) -> str:
+    """The compile-accounting block: one row per kind="compile" record
+    (program, compile seconds, model GFLOP and MB accessed per
+    execution, temp bytes — docs/OBSERVABILITY.md "Compile
+    accounting")."""
+    recs = compile_records(streams)
+    if not recs:
+        return ""
+    header = ("run_id", "rank", "program", "compile_s", "GFLOP", "MB_acc",
+              "MB_temp", "n")
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return "-" if not math.isfinite(v) else f"{v:.4g}"
+        return str(v)
+
+    rows = []
+    for r in recs:
+        rows.append((
+            r.get("run_id", "?"), r.get("rank", "?"),
+            r.get("program", "?"), r.get("compile_time_s"),
+            r["flops"] / 1e9 if _finite(r.get("flops")) else None,
+            r["bytes_accessed"] / 1e6 if _finite(r.get("bytes_accessed")) else None,
+            r["temp_bytes"] / 1e6 if _finite(r.get("temp_bytes")) else None,
+            r.get("compiles", 1),
+        ))
+    cells = [header] + [tuple(fmt(c) for c in row) for row in rows]
+    widths = [max(len(r[i]) for r in cells) for i in range(len(header))]
+    lines = ["compiles (kind=compile):"]
+    for i, row in enumerate(cells):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
 
 
 def render_serve_table(streams: dict) -> str:
@@ -806,11 +894,14 @@ def main(argv=None) -> int:
                 s["eval_auc"],
             ))
         serve_table = render_serve_table(streams)
+        compile_table = render_compile_table(streams)
         if rows:
             print(render_table(rows))
         if serve_table:
             print(serve_table)
-        if not rows and not serve_table:
+        if compile_table:
+            print(compile_table)
+        if not rows and not serve_table and not compile_table:
             print("metrics_report: no records found", file=sys.stderr)
             return 1
     if skipped:

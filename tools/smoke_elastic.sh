@@ -101,4 +101,8 @@ assert gens == {0, 1}, f"expected generations {{0, 1}}, got {gens}"
 print("smoke_elastic: kill drill accounting OK "
       f"(step {step}, examples {ds['examples']}, generations {sorted(gens)})")
 EOF
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
 echo "smoke_elastic: OK"

@@ -186,4 +186,8 @@ grep -q '"event": "reload"' "$WORK/run_serve/serve_rank0.jsonl" || {
 python tools/metrics_report.py "$WORK/run_serve" --bench-json - \
     | grep -q serve_qps || { echo "smoke_serve: no serve bench record"; exit 1; }
 
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
 echo "smoke_serve: OK"

@@ -61,4 +61,8 @@ python tools/metrics_report.py "$WORK/run"
 python tools/metrics_report.py "$WORK/run" --bench-json "$BENCH_OUT"
 # regression gate self-check: a run can never regress against itself
 python tools/metrics_report.py "$WORK/run" --regress "$BENCH_OUT" >/dev/null
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
 echo "smoke_telemetry: OK"

@@ -219,4 +219,8 @@ else
     echo "smoke_topology: shrink drill skipped (multi-process CPU unsupported by this jax build)"
 fi
 
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
 echo "smoke_topology: OK"

@@ -1,31 +1,80 @@
-"""Decompose the FM/LR train step cost on the real chip.
+#!/usr/bin/env python3
+"""Decompose the train-step cost on the real chip, machine-readably.
 
-Uses the bench.py harness (lax.scan over K pre-staged distinct batches,
-host-read sync) with progressively larger slices of the step:
+Uses the bench.py harness (lax.scan over K pre-staged distinct batches
+— staging shared via `bench.stage_row_batches`, host-read sync) with
+progressively larger slices of the step:
+
   fwd      — forward + loss only
   grad     — + backward (gradients materialized into the carry)
   step     — + optimizer update (the full train step)
+
 The deltas attribute the step time to forward gather, backward scatter,
-and dense optimizer update respectively.
+and dense optimizer update respectively. Output is one BENCH-shaped
+JSON record per (model, slice) on stdout —
+
+  {"metric": "decompose_fm_fwd_ms", "value": 52.2, "unit": "ms/step",
+   "model": "fm", "slice": "fwd", ...}
+
+— so a decomposition run lands in the same trajectory tooling as every
+other datapoint (tools/perf_ledger.py folds explicit files in); the
+human summary line per model goes to stderr. The full-step slice also
+carries the CompileRecorder's compile time and cost analysis.
+
+    python tools/step_decompose.py                     # lr + fm, bench shape
+    python tools/step_decompose.py --models fm --smoke # tiny CPU shapes
+    python tools/step_decompose.py --json out.jsonl
 """
 
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main():
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-slice (fwd/grad/step) train-step cost decomposition"
+    )
+    ap.add_argument("--models", default="lr,fm",
+                    help="comma-separated model list (default lr,fm)")
+    ap.add_argument("--scan-steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument("--nnz", type=int, default=32)
+    ap.add_argument("--log2-slots", type=int, default=22)
+    ap.add_argument("--repeats", type=int, default=4)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI (CPU-friendly)")
+    ap.add_argument("--json", default="-", metavar="OUT",
+                    help="where the JSON records go (default stdout)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.batch, args.nnz, args.log2_slots = 1024, 8, 14
+        args.scan_steps, args.repeats = 2, 2
+
     import jax
     import jax.numpy as jnp
 
+    from bench import stage_row_batches
     from xflow_tpu.config import Config, override
     from xflow_tpu.models import get_model
     from xflow_tpu.optim import get_optimizer
+    from xflow_tpu.telemetry import CompileRecorder
     from xflow_tpu.train.state import init_state
     from xflow_tpu.train.step import loss_fn, make_train_step
 
-    K, B, F, LOG2 = 8, 65536, 32, 22
-    for model_name in ("lr", "fm"):
+    K, B, F, LOG2 = args.scan_steps, args.batch, args.nnz, args.log2_slots
+    out_f = sys.stdout if args.json == "-" else open(args.json, "w")
+    records = []
+
+    for model_name in [m for m in args.models.split(",") if m]:
         cfg = override(
             Config(),
             **{
@@ -38,23 +87,24 @@ def main():
         model, opt = get_model(model_name), get_optimizer("ftrl")
         state = init_state(model, opt, cfg)
         rng = np.random.default_rng(0)
+        # the SAME staging the bench harness uses (bench.py) — one
+        # distribution, one harness, no drift between the two tools
         batches = {
-            "slots": jnp.asarray(rng.integers(0, cfg.num_slots, (K, B, F)), jnp.int32),
-            "fields": jnp.asarray(rng.integers(0, cfg.model.num_fields, (K, B, F)), jnp.int32),
-            "mask": jnp.asarray((rng.random((K, B, F)) < 0.6).astype(np.float32)),
-            "labels": jnp.asarray((rng.random((K, B)) < 0.4).astype(np.float32)),
-            "row_mask": jnp.ones((K, B), jnp.float32),
+            k: jnp.asarray(v)
+            for k, v in stage_row_batches(
+                rng, cfg.num_slots, cfg.model.num_fields, K, B, F
+            ).items()
         }
+        crec = CompileRecorder()
 
-        def time_variant(fn, carry):
-            @jax.jit
-            def run(c, bs):
-                return jax.lax.scan(fn, c, bs)
-
+        def time_variant(tag, fn, carry):
+            run = crec.wrap(f"decompose.{model_name}.{tag}", jax.jit(
+                lambda c, bs: jax.lax.scan(fn, c, bs)
+            ))
             c, out = run(carry, batches)
             _ = float(jax.tree.leaves(out)[0].ravel()[-1])
             best = float("inf")
-            for _ in range(4):
+            for _ in range(args.repeats):
                 t0 = time.perf_counter()
                 c, out = run(carry, batches)
                 _ = float(jax.tree.leaves(out)[0].ravel()[-1])
@@ -65,7 +115,7 @@ def main():
         def fwd(tables, batch):
             return tables, loss_fn(tables, batch, model, cfg)
 
-        t_fwd = time_variant(fwd, state.tables)
+        t_fwd = time_variant("fwd", fwd, state.tables)
 
         # grad: tables updated by -1e-9*grad so the scatter result is live
         def grad(tables, batch):
@@ -73,7 +123,7 @@ def main():
             new = jax.tree.map(lambda t, gg: t - 1e-9 * gg, tables, g)
             return new, loss
 
-        t_grad = time_variant(grad, state.tables)
+        t_grad = time_variant("grad", grad, state.tables)
 
         step = make_train_step(model, opt, cfg, jit=False)
 
@@ -81,14 +131,42 @@ def main():
             st, m = step(st, batch)
             return st, m["loss"]
 
-        t_full = time_variant(full, state)
+        t_full = time_variant("step", full, state)
 
+        ts = round(time.time(), 3)
+        shape = {"batch": B, "nnz": F, "log2_slots": LOG2, "scan_steps": K}
+        for tag, best in (("fwd", t_fwd), ("grad", t_grad), ("step", t_full)):
+            rec = {
+                "metric": f"decompose_{model_name}_{tag}_ms",
+                "value": round(best * 1e3, 3),
+                "unit": "ms/step",
+                "model": model_name,
+                "slice": tag,
+                **shape,
+                "ts": ts,
+            }
+            if tag == "step":
+                info = crec.latest(f"decompose.{model_name}.step")
+                if info and info.get("flops"):
+                    rec["compile_time_s"] = round(info["compile_time_s"], 3)
+                    rec["flops_per_example"] = round(info["flops"] / (K * B), 2)
+                    if info.get("bytes_accessed"):
+                        rec["bytes_per_example"] = round(
+                            info["bytes_accessed"] / (K * B), 2
+                        )
+            records.append(rec)
+            print(json.dumps(rec), file=out_f)
+        out_f.flush()
         print(
             f"{model_name}: fwd={t_fwd*1e3:7.1f} ms  +bwd={t_grad*1e3:7.1f} ms "
             f"(bwd ~{(t_grad-t_fwd)*1e3:6.1f})  full={t_full*1e3:7.1f} ms "
-            f"(opt ~{(t_full-t_grad)*1e3:6.1f})"
+            f"(opt ~{(t_full-t_grad)*1e3:6.1f})",
+            file=sys.stderr,
         )
+    if out_f is not sys.stdout:
+        out_f.close()
+    return 0 if records else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

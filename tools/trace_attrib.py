@@ -1,0 +1,273 @@
+#!/usr/bin/env python3
+"""Attribute an xprof trace's device-op time to the step's named
+scopes (docs/OBSERVABILITY.md "Trace attribution").
+
+The TraceWindow (`train.trace_start_step`/`train.trace_num_steps`)
+captures a steady-state trace nobody could read as op soup: hundreds of
+fused HLO ops per step. The step builders already label the program
+with `jax.named_scope`s (gather / loss / grad / optimizer /
+scatter_optimizer / train_step), and the CompileRecorder stamps every
+compile record with the {optimized-HLO op -> scope} map scraped from
+the compiled module's metadata — this tool joins the two:
+
+    python tools/trace_attrib.py /runs/exp1/prof --run-dir /runs/exp1
+    python tools/trace_attrib.py trace.json.gz --run-dir /runs/exp1 --json -
+
+and prints the per-scope device-time table ("the gather is 34% of the
+step") that is the before/after evidence any kernel PR needs.
+
+How the join works, per trace event (Chrome-trace `ph == "X"`):
+
+1. the event's `args.hlo_op` (CPU backend) or name is looked up in the
+   op->scope map from the `kind="compile"` records under --run-dir —
+   keyed per `hlo_module` when both the record and the event carry the
+   module name (HLO op names are only unique within one module, so a
+   run that compiled train_step AND predict never cross-attributes),
+   with a flat merged map (newest mapping wins) for events/records
+   that lack it;
+2. failing that, any path-shaped arg value (`tf_op` / `long_name` /
+   `name`, the TPU backends' op metadata) is split on "/" and the last
+   component matching a known scope label attributes the event;
+3. with NO map available at all (no --run-dir), a last-resort keyword
+   match on the op name itself runs (a `bitcast_gather_fusion` counts
+   as "gather") — honest enough for a quick look, but it cannot tell a
+   backward gather under `grad` from the forward's, so the compile-
+   record join is the real path. Unmatched device ops bucket "other";
+   host-side python events are excluded entirely.
+
+Exit codes: 0 = table printed; 1 = no device-op events in the trace;
+2 = no trace found / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xflow_tpu.jsonl import read_jsonl  # noqa: E402
+from xflow_tpu.telemetry import SCOPE_LABELS  # noqa: E402
+
+
+def find_trace(path: str) -> str:
+    """`path` itself when it is a trace file, else the newest
+    *.trace.json(.gz) under it (TraceWindow writes
+    <profile_dir>/plugins/profile/<ts>/<host>.trace.json.gz)."""
+    if os.path.isfile(path):
+        return path
+    hits = glob.glob(os.path.join(path, "**", "*.trace.json.gz"), recursive=True)
+    hits += glob.glob(os.path.join(path, "**", "*.trace.json"), recursive=True)
+    if not hits:
+        raise FileNotFoundError(f"no *.trace.json(.gz) under {path!r}")
+    return max(hits, key=os.path.getmtime)
+
+
+def load_trace(path: str) -> list:
+    """The trace's event list, from gzip or plain chrome-trace JSON."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data if isinstance(data, list) else []
+
+
+def load_op_scopes(run_dir: str) -> tuple[dict, dict]:
+    """({hlo_module: {op -> scope}}, flat merged {op -> scope}) over
+    every kind="compile" record in the run dir's JSONL files (newest
+    mapping wins — a recompile's map supersedes). The per-module maps
+    drive the join when the trace event names its module; the flat map
+    is the fallback for records or events without one."""
+    by_module: dict = {}
+    flat: dict = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, "*.jsonl"))):
+        for rec in read_jsonl(path, warn=False):
+            if rec.get("kind") == "compile" and isinstance(
+                rec.get("op_scopes"), dict
+            ):
+                flat.update(rec["op_scopes"])
+                if rec.get("hlo_module"):
+                    by_module.setdefault(rec["hlo_module"], {}).update(
+                        rec["op_scopes"]
+                    )
+    return by_module, flat
+
+
+def scope_of(
+    name: str, args: dict, by_module: dict, op_scopes: dict, scopes: tuple,
+    keyword_ok: bool
+) -> str:
+    """One event's scope bucket (see module docstring for the order)."""
+    op = args.get("hlo_op") if isinstance(args, dict) else None
+    mod_map = (
+        by_module.get(args.get("hlo_module")) if isinstance(args, dict) else None
+    )
+    if mod_map is not None:
+        # the event's own module is known: its map is authoritative —
+        # never fall through to another program's identically-named op
+        for key in (op, name):
+            if key and key in mod_map:
+                return mod_map[key]
+    else:
+        for key in (op, name):
+            if key and key in op_scopes:
+                return op_scopes[key]
+    # path-shaped metadata (TPU op events): last scope component wins,
+    # excluding the final component (the primitive name)
+    candidates = [name] if "/" in name else []
+    if isinstance(args, dict):
+        for k in ("tf_op", "long_name", "name"):
+            v = args.get(k)
+            if isinstance(v, str) and "/" in v:
+                candidates.append(v)
+    for path in candidates:
+        comps = path.split("/")
+        for comp in reversed(comps[:-1]):
+            if comp in scopes:
+                return comp
+    if keyword_ok:
+        for scope in scopes:
+            base = scope.split("_")[0]  # scatter_optimizer -> scatter
+            if base and base in (op or name or ""):
+                return scope
+    return "other"
+
+
+def attribute(
+    events: list, by_module: dict, op_scopes: dict, scopes: tuple
+) -> tuple[dict, dict, float]:
+    """({scope: total_us}, {scope: event count}, total_us) over the
+    trace's device-op events. Device-op = a complete event carrying an
+    `hlo_op` arg (CPU backend) or living on a `/device:` process row
+    (TPU/GPU backends) — minus the "XLA Modules"/"Steps" summary rows,
+    whose spans aggregate the op rows over the same wall time."""
+    device_pids = set()
+    summary_tids = set()  # (pid, tid) rows whose spans AGGREGATE ops
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name":
+            pname = str(args.get("name", ""))
+            if "/device:" in pname or pname.startswith("TPU"):
+                device_pids.add(e.get("pid"))
+        elif e.get("name") == "thread_name":
+            # TPU xprof device rows: "XLA Ops" holds the per-op events;
+            # "XLA Modules"/"Steps" rows span WHOLE program executions
+            # over the same wall time — counting both double-counts
+            # every op and halves every per-scope percentage
+            tname = str(args.get("name", "")).lower()
+            if "module" in tname or tname.startswith("step"):
+                summary_tids.add((e.get("pid"), e.get("tid")))
+    keyword_ok = not op_scopes
+    totals: dict = {}
+    counts: dict = {}
+    total_us = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        is_device = (isinstance(args, dict) and "hlo_op" in args) or (
+            e.get("pid") in device_pids
+        )
+        if not is_device:
+            continue
+        if "hlo_op" not in args and (e.get("pid"), e.get("tid")) in summary_tids:
+            continue  # an op event is never excluded, a summary span is
+        dur = e.get("dur")
+        if not isinstance(dur, (int, float)) or dur <= 0:
+            continue
+        scope = scope_of(str(e.get("name", "")), args, by_module, op_scopes,
+                         scopes, keyword_ok)
+        totals[scope] = totals.get(scope, 0.0) + float(dur)
+        counts[scope] = counts.get(scope, 0) + 1
+        total_us += float(dur)
+    return totals, counts, total_us
+
+
+def render(totals: dict, counts: dict, total_us: float) -> str:
+    rows = sorted(totals.items(), key=lambda kv: -kv[1])
+    lines = ["scope                 device_ms       %   events",
+             "-----                 ---------       -   ------"]
+    for scope, us in rows:
+        lines.append(
+            f"{scope:<20}  {us / 1e3:>9.3f}  {100.0 * us / total_us:>6.1f}"
+            f"   {counts[scope]:>6}"
+        )
+    lines.append(
+        f"{'total':<20}  {total_us / 1e3:>9.3f}   100.0   {sum(counts.values()):>6}"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bucket an xprof trace's device-op time by the step's "
+        "named scopes"
+    )
+    ap.add_argument("trace", help="profile dir (train.profile_dir) or a "
+                                  "*.trace.json(.gz) file")
+    ap.add_argument("--run-dir", default="",
+                    help="run dir holding metrics JSONL with kind=\"compile\" "
+                         "records — their op_scopes maps drive the join")
+    ap.add_argument("--scopes", default=",".join(SCOPE_LABELS),
+                    help="comma-separated scope labels (default: the step "
+                         "builders' named scopes)")
+    ap.add_argument("--json", default="", metavar="OUT",
+                    help="also write {scope: {ms, pct, events}} JSON "
+                         "('-' = stdout)")
+    args = ap.parse_args(argv)
+
+    scopes = tuple(s for s in args.scopes.split(",") if s)
+    try:
+        trace_path = find_trace(args.trace)
+        events = load_trace(trace_path)
+    except (OSError, json.JSONDecodeError, FileNotFoundError) as e:
+        print(f"trace_attrib: {e}", file=sys.stderr)
+        return 2
+    by_module, op_scopes = (
+        load_op_scopes(args.run_dir) if args.run_dir else ({}, {})
+    )
+    if args.run_dir and not op_scopes:
+        print(
+            f"trace_attrib: warning: no kind=\"compile\" op_scopes under "
+            f"{args.run_dir!r}; falling back to path/keyword matching",
+            file=sys.stderr,
+        )
+    totals, counts, total_us = attribute(events, by_module, op_scopes, scopes)
+    if total_us <= 0:
+        print(
+            f"trace_attrib: no device-op events in {trace_path!r} "
+            "(trace captured before any step dispatched?)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"# trace: {trace_path}")
+    if op_scopes:
+        print(f"# op->scope map: {len(op_scopes)} ops from {args.run_dir!r}")
+    print(render(totals, counts, total_us))
+    if args.json:
+        payload = {
+            scope: {
+                "ms": round(us / 1e3, 3),
+                "pct": round(100.0 * us / total_us, 2),
+                "events": counts[scope],
+            }
+            for scope, us in sorted(totals.items(), key=lambda kv: -kv[1])
+        }
+        out = json.dumps({"total_ms": round(total_us / 1e3, 3), "scopes": payload})
+        if args.json == "-":
+            print(out)
+        else:
+            with open(args.json, "w") as f:
+                f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

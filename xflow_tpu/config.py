@@ -343,6 +343,17 @@ class TrainConfig:
     # stall (faulthandler), then re-arm when progress resumes. SIGUSR1
     # stack dumps are always installed during fit() (main thread only).
     hang_timeout_s: float = 0.0
+    # compile accounting (docs/OBSERVABILITY.md "Compile accounting"):
+    # every step/predict compilation routes through a shared
+    # telemetry.CompileRecorder — explicit .lower().compile() with the
+    # compile timed and XLA's cost/memory analysis captured into
+    # kind="compile" records in the metrics JSONL, plus the
+    # {HLO op -> named_scope} map tools/trace_attrib.py joins traces
+    # against, and the recompile counter metrics_report --check gates
+    # on ("each program compiles exactly once per run"). The compile
+    # itself costs the same either way (jit would have built the same
+    # executable lazily); off restores the implicit-jit path.
+    compile_metrics: bool = True
 
 
 @dataclass(frozen=True)

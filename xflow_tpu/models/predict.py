@@ -39,14 +39,19 @@ def predict_fn(tables, batch: dict, model: Model, cfg: Config):
     return reference_pctr(model.forward(tables, batch, cfg))
 
 
-def make_predict_fn(model: Model, cfg: Config, jit: bool = True) -> Callable:
+def make_predict_fn(model: Model, cfg: Config, jit: bool = True,
+                    recorder=None, name: str = "predict") -> Callable:
     """Returns pctr_step(tables, batch_arrays) -> pctr [B].
 
     The single factory behind `make_eval_step` AND the serve runner —
     offline eval and online serving cannot drift because they compile
-    the same function."""
+    the same function. `recorder` (telemetry.CompileRecorder) routes
+    the jit through the compile-accounting seam under `name`."""
 
     def step(tables, batch: dict):
         return predict_fn(tables, batch, model, cfg)
 
-    return jax.jit(step) if jit else step
+    if not jit:
+        return step
+    jitted = jax.jit(step)
+    return recorder.wrap(name, jitted) if recorder is not None else jitted

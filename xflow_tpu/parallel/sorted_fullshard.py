@@ -408,7 +408,7 @@ def _batch_mode(cfg: Config, batch: dict) -> str:
     return "ffm" if cfg.model.name == "ffm" else "fm"
 
 
-def make_fullshard_eval_step(cfg: Config, mesh: Mesh) -> Callable:
+def make_fullshard_eval_step(cfg: Config, mesh: Mesh, recorder=None) -> Callable:
     """Forward-only fullshard step: eval consumes the SAME host plan the
     train step does (fs_* buffers, one all_to_all + owner_reduce)
     instead of shipping the dead row-major [B, F] arrays (~24 MB/batch
@@ -459,14 +459,19 @@ def make_fullshard_eval_step(cfg: Config, mesh: Mesh) -> Callable:
         mode = _batch_mode(cfg, batch)
         if mode not in jitted:
             step, keys = build(mode)
-            jitted[mode] = (jax.jit(step), keys)
+            fn = jax.jit(step)
+            if recorder is not None:
+                fn = recorder.wrap(f"predict.fullshard.{mode}", fn)
+            jitted[mode] = (fn, keys)
         fn, keys = jitted[mode]
         return fn(tables, {k: batch[k] for k in keys})
 
     return call
 
 
-def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
+def make_fullshard_train_step(
+    optimizer, cfg: Config, mesh: Mesh, recorder=None
+) -> Callable:
     """FM/MVM train step with everything sharded over ('data','table').
 
     MVM runs in one of two row-side modes, chosen PER BATCH by the
@@ -583,15 +588,15 @@ def make_fullshard_train_step(optimizer, cfg: Config, mesh: Mesh) -> Callable:
         if mode not in jitted:
             step, bsh = build(mode)
             ssh = state_shardings(state, mesh)
-            jitted[mode] = (
-                jax.jit(
-                    step,
-                    in_shardings=(ssh, bsh),
-                    out_shardings=(ssh, {k: rep for k in metrics_keys(cfg)}),
-                    donate_argnums=(0,),
-                ),
-                bsh,
+            fn = jax.jit(
+                step,
+                in_shardings=(ssh, bsh),
+                out_shardings=(ssh, {k: rep for k in metrics_keys(cfg)}),
+                donate_argnums=(0,),
             )
+            if recorder is not None:
+                fn = recorder.wrap(f"train_step.fullshard.{mode}", fn)
+            jitted[mode] = (fn, bsh)
         fn, bsh = jitted[mode]
         return fn(state, {k: batch[k] for k in bsh})
 

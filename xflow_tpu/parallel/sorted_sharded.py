@@ -104,10 +104,12 @@ def sorted_batch_sharding(mesh: Mesh) -> dict:
 
 
 def make_sorted_sharded_train_step(
-    optimizer, cfg: Config, mesh: Mesh
+    optimizer, cfg: Config, mesh: Mesh, recorder=None
 ) -> Callable:
     """FM train step over ('data','table'): Pallas sorted kernels on the
     local table shard, one row-sum psum, shard_map-transposed grad psum.
+    `recorder` routes the jit through the compile-accounting seam
+    (telemetry.CompileRecorder, program "train_step.replicated").
     """
     validate_sorted_sharded(cfg, mesh)
     S = cfg.num_slots
@@ -230,6 +232,8 @@ def make_sorted_sharded_train_step(
         out_shardings=(state_sh, {k: rep for k in metrics_keys(cfg)}),
         donate_argnums=(0,),
     )
+    if recorder is not None:
+        jitted = recorder.wrap("train_step.replicated", jitted)
 
     def call(state: TrainState, batch: dict):
         # tolerate a batch dict carrying extra keys (slots/fields/mask for

@@ -37,7 +37,7 @@ def shard_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 
 def make_sharded_train_step(
-    model: Model, optimizer: Optimizer, cfg: Config, mesh: Mesh
+    model: Model, optimizer: Optimizer, cfg: Config, mesh: Mesh, recorder=None
 ) -> Callable:
     step = make_train_step(model, optimizer, cfg, jit=False, allow_fused=False)
     # state shardings depend only on pytree structure; build from a spec of
@@ -67,19 +67,28 @@ def make_sharded_train_step(
             donate_argnums=(0,),
         )
 
-    # cache the jitted fn per batch-key set (state structure is fixed)
+    # cache the jitted fn per batch-key set (state structure is fixed);
+    # the compile recorder (one shared program name — signatures tell
+    # the key sets apart) gives each set its kind="compile" record
     cache = {}
 
     def call(state: TrainState, batch: dict):
         key = frozenset(batch)
         if key not in cache:
-            cache[key] = wrap(state, batch)
+            jitted = wrap(state, batch)
+            cache[key] = (
+                recorder.wrap("train_step.gspmd", jitted)
+                if recorder is not None
+                else jitted
+            )
         return cache[key](state, batch)
 
     return call
 
 
-def make_sharded_eval_step(model: Model, cfg: Config, mesh: Mesh) -> Callable:
+def make_sharded_eval_step(
+    model: Model, cfg: Config, mesh: Mesh, recorder=None
+) -> Callable:
     ev = make_eval_step(model, cfg, jit=False)
     bsh = batch_sharding(mesh)
     cache = {}
@@ -98,10 +107,15 @@ def make_sharded_eval_step(model: Model, cfg: Config, mesh: Mesh) -> Callable:
         )
         key = (frozenset(batch), tuple(jax.tree.leaves(tsh)))
         if key not in cache:
-            cache[key] = jax.jit(
+            jitted = jax.jit(
                 ev,
                 in_shardings=(tsh, {k: bsh[k] for k in batch}),
                 out_shardings=NamedSharding(mesh, P("data")),
+            )
+            cache[key] = (
+                recorder.wrap("predict.gspmd", jitted)
+                if recorder is not None
+                else jitted
             )
         return cache[key](tables, batch)
 

@@ -72,6 +72,12 @@ class ServeMetrics:
         self._win_start = time.perf_counter()
         self._reset_window_locked()
 
+    @property
+    def appender(self) -> JsonlAppender:
+        """The underlying stamped sink — serve_main binds the compile
+        recorder to it so kind="compile" records join this stream."""
+        return self._app
+
     def _reset_window_locked(self) -> None:
         self._requests = 0
         self._rows = 0

@@ -91,7 +91,7 @@ class ServeRunner:
     mesh is whatever local devices exist — pass `mesh` to pjit-shard
     the tables over them, None for single-device)."""
 
-    def __init__(self, cfg: Config, mesh=None):
+    def __init__(self, cfg: Config, mesh=None, recorder=None):
         from xflow_tpu.models import get_model
         from xflow_tpu.optim import get_optimizer
 
@@ -102,11 +102,22 @@ class ServeRunner:
         self._gen: Optional[Generation] = None
         self._gen_counter = 0
         self._reload_lock = threading.Lock()  # one loader at a time
+        # compile accounting (train.compile_metrics): the predict
+        # program routes through the same CompileRecorder seam the
+        # trainer's engines use, so a serving run's stream carries its
+        # kind="compile" record too (serve_main binds the sink)
+        if recorder is None and cfg.train.compile_metrics:
+            from xflow_tpu.telemetry import CompileRecorder
+
+            recorder = CompileRecorder()
+        self.compile_recorder = recorder
         if mesh is not None:
             from xflow_tpu.parallel.mesh import batch_sharding
             from xflow_tpu.parallel.train_step import make_sharded_eval_step
 
-            self._predict_step = make_sharded_eval_step(self.model, cfg, mesh)
+            self._predict_step = make_sharded_eval_step(
+                self.model, cfg, mesh, recorder=recorder
+            )
             bsh = batch_sharding(mesh)
             import jax
 
@@ -116,7 +127,9 @@ class ServeRunner:
         else:
             from xflow_tpu.models.predict import make_predict_fn
 
-            self._predict_step = make_predict_fn(self.model, cfg)
+            self._predict_step = make_predict_fn(
+                self.model, cfg, recorder=recorder, name="predict.serve"
+            )
             import jax
 
             self._put = jax.device_put

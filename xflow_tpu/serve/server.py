@@ -291,6 +291,10 @@ def serve_main(cfg: Config, mesh=None, ready_out=None) -> int:
     runner = ServeRunner(cfg, mesh=mesh)
     gen = runner.load()  # startup: no checkpoint IS fatal
     app = ServeApp(cfg, runner)
+    if runner.compile_recorder is not None:
+        # compile records join the serve stream (the predict program
+        # compiles lazily on the first batch, after this bind)
+        runner.compile_recorder.bind(app.metrics.appender)
     app.metrics.event("start", generation=gen.gen, step=gen.step)
     watcher = CheckpointWatcher(
         runner,

@@ -302,12 +302,17 @@ def _fused_sorted_step(state: TrainState, batch: dict, cfg: Config):
 
 
 def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool = True,
-                    allow_fused: bool = True) -> Callable:
+                    allow_fused: bool = True, recorder=None) -> Callable:
     """Returns train_step(state, batch_arrays) -> (state, metrics).
 
     `allow_fused=False` (the sharded builders) disables the fused
     scatter+FTRL path regardless of config — the fusion's contract is
-    the single-device step (`_fused_scatter_eligible`)."""
+    the single-device step (`_fused_scatter_eligible`).
+
+    `recorder` (telemetry.CompileRecorder) routes the jit through the
+    compile-accounting seam: explicit timed .lower().compile() with
+    cost/memory analysis into a kind="compile" record, program name
+    "train_step"."""
     fuse = _fused_scatter_eligible(cfg, allow_fused)
 
     def train_step(state: TrainState, batch: dict):
@@ -359,10 +364,12 @@ def make_train_step(model: Model, optimizer: Optimizer, cfg: Config, jit: bool =
     if jit:
         # donate the state: tables and optimizer state update in place in HBM
         train_step = jax.jit(train_step, donate_argnums=(0,))
+        if recorder is not None:
+            return recorder.wrap("train_step", train_step)
     return train_step
 
 
-def make_eval_step(model: Model, cfg: Config, jit: bool = True) -> Callable:
+def make_eval_step(model: Model, cfg: Config, jit: bool = True, recorder=None) -> Callable:
     """Returns eval_step(tables, batch_arrays) -> pctr [B].
 
     Delegates to the ONE shared pctr forward (models/predict.py
@@ -370,4 +377,4 @@ def make_eval_step(model: Model, cfg: Config, jit: bool = True) -> Callable:
     offline eval and online serving cannot drift."""
     from xflow_tpu.models.predict import make_predict_fn
 
-    return make_predict_fn(model, cfg, jit=jit)
+    return make_predict_fn(model, cfg, jit=jit, recorder=recorder)

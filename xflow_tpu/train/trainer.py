@@ -37,6 +37,7 @@ from xflow_tpu.telemetry import (
     StepTimer,
     TraceWindow,
     default_registry,
+    hbm_window_fields,
     install_stack_dump_handler,
     resolve_run_id,
 )
@@ -104,6 +105,27 @@ class Trainer:
         self.optimizer = get_optimizer(cfg.optim.name)
         self.mesh = mesh
         self.rank = process_index
+        # provenance stamp: every metrics record carries ts/rank/run_id
+        # (jsonl.JsonlAppender) so per-rank streams from one run join.
+        # Built BEFORE the engines: the compile recorder below is the
+        # seam every step/predict jit routes through, and its
+        # kind="compile" records land in the same stamped stream.
+        self.run_id = resolve_run_id()
+        self.metrics = MetricsLogger(
+            cfg.train.metrics_path,
+            stamp={"rank": self.rank, "run_id": self.run_id},
+        )
+        # compile accounting (train.compile_metrics, docs/OBSERVABILITY.md
+        # "Compile accounting"): explicit timed .lower().compile() per
+        # program with XLA cost/memory analysis; recompiles counted
+        from xflow_tpu.telemetry import CompileRecorder
+
+        self.compile_recorder = (
+            CompileRecorder(sink=self.metrics)
+            if cfg.train.compile_metrics
+            else None
+        )
+        _rec = self.compile_recorder
         # sorted-window table layout (ops/sorted_table.py):
         # - single device: fused-FM and MVM (Pallas kernels / XLA fallback)
         # - mesh: fused-FM and MVM via one of two engines selected by
@@ -222,7 +244,7 @@ class Trainer:
                     init_state(self.model, self.optimizer, cfg), mesh
                 )
                 fullshard_step = make_fullshard_train_step(
-                    self.optimizer, cfg, mesh
+                    self.optimizer, cfg, mesh, recorder=_rec
                 )
                 # per-batch dispatch: a batch too skewed for the buffer
                 # capacity arrives as row-major arrays (single-process
@@ -236,7 +258,8 @@ class Trainer:
                         return fullshard_step(state, batch)
                     if "step" not in gspmd:
                         gspmd["step"] = make_sharded_train_step(
-                            self.model, self.optimizer, cfg, mesh
+                            self.model, self.optimizer, cfg, mesh,
+                            recorder=_rec,
                         )
                     return gspmd["step"](state, batch)
 
@@ -257,13 +280,15 @@ class Trainer:
                     init_state(self.model, self.optimizer, cfg), mesh
                 )
                 self.train_step = make_sorted_sharded_train_step(
-                    self.optimizer, cfg, mesh
+                    self.optimizer, cfg, mesh, recorder=_rec
                 )
             else:
                 self.state = shard_state(
                     init_state(self.model, self.optimizer, cfg), mesh
                 )
-                self.train_step = make_sharded_train_step(self.model, self.optimizer, cfg, mesh)
+                self.train_step = make_sharded_train_step(
+                    self.model, self.optimizer, cfg, mesh, recorder=_rec
+                )
             # eval: the fullshard engine consumes the SAME host plan as
             # training (round-3 weak #5: the row-major [B, F] arrays are
             # dead ~24 MB/batch transfers there); overflow-fallback
@@ -271,13 +296,13 @@ class Trainer:
             # (make_sharded_eval_step adopts the tables' LIVE sharding
             # as its in_sharding — jit never reshards explicit
             # in_shardings). The replicated engine keeps row-major eval.
-            gspmd_eval = make_sharded_eval_step(self.model, cfg, mesh)
+            gspmd_eval = make_sharded_eval_step(self.model, cfg, mesh, recorder=_rec)
             if self._mesh_engine == "fullshard":
                 from xflow_tpu.parallel.sorted_fullshard import (
                     make_fullshard_eval_step,
                 )
 
-                fullshard_eval = make_fullshard_eval_step(cfg, mesh)
+                fullshard_eval = make_fullshard_eval_step(cfg, mesh, recorder=_rec)
 
                 def _eval_dispatch(tables, arrays):
                     if "fs_slots" in arrays:
@@ -290,8 +315,10 @@ class Trainer:
             self._shard_batch = lambda b: _shard_batch_arrays(b, mesh)
         else:
             self.state = init_state(self.model, self.optimizer, cfg)
-            self.train_step = make_train_step(self.model, self.optimizer, cfg)
-            self.eval_step = make_eval_step(self.model, cfg)
+            self.train_step = make_train_step(
+                self.model, self.optimizer, cfg, recorder=_rec
+            )
+            self.eval_step = make_eval_step(self.model, cfg, recorder=_rec)
             # ONE async device_put for the whole dict: per-array jnp.asarray
             # is a synchronous round trip each, which dominates on
             # high-latency links (tunneled devices: ~9 arrays × RTT/step)
@@ -307,13 +334,6 @@ class Trainer:
             else 0
         )
         self._dedup_on = None  # undecided until the first row-major batch
-        # provenance stamp: every metrics record carries ts/rank/run_id
-        # (jsonl.JsonlAppender) so per-rank streams from one run join
-        self.run_id = resolve_run_id()
-        self.metrics = MetricsLogger(
-            cfg.train.metrics_path,
-            stamp={"rank": self.rank, "run_id": self.run_id},
-        )
         # model-health monitor (train.health_metrics, docs/OBSERVABILITY.md
         # "Health metrics"): consumes the step builders' fused norm
         # scalars one step behind, owns the loss EMA and the
@@ -821,6 +841,14 @@ class Trainer:
 
         return flag, restore
 
+    def _step_cost(self) -> Optional[dict]:
+        """{"flops", "bytes"} per train-step execution from the newest
+        compiled train program's cost analysis — the roofline numerators
+        the StepTimer's window gauges consume. None until a train
+        program compiled (or with compile accounting off)."""
+        rec = self.compile_recorder
+        return rec.latest_cost("train_step") if rec is not None else None
+
     def fit(self, train_path: Optional[str] = None) -> TrainResult:
         try:
             return self._fit(train_path)
@@ -1063,8 +1091,13 @@ class Trainer:
                         # window stats: rows/s, steps/s, p50/p99 step
                         # time, data-wait/dispatch/device decomposition
                         # (telemetry.StepTimer; empty only at step 1
-                        # under log_every=1 — timing runs one behind)
-                        rec.update(steptimer.window_record())
+                        # under log_every=1 — timing runs one behind),
+                        # plus the measured roofline gauges when the
+                        # compile recorder knows the step's cost
+                        rec.update(steptimer.window_record(cost=self._step_cost()))
+                        # live HBM gauges (guarded: CPU allocators
+                        # report nothing and the fields simply stay out)
+                        rec.update(hbm_window_fields(registry))
                         # health window: norms, loss EMA, occupancy /
                         # collision gauges (one behind, like the timer)
                         rec.update(health.window_record())
@@ -1260,7 +1293,8 @@ class Trainer:
             "occupancy": res.occupancy,
         }
         # tail window (steps since the last log tick) + run-total counters
-        final_rec.update(steptimer.window_record())
+        final_rec.update(steptimer.window_record(cost=self._step_cost()))
+        final_rec.update(hbm_window_fields(registry))
         final_rec.update(health.window_record())
         counters = registry.snapshot()
         if counters:
