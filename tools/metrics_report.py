@@ -93,9 +93,14 @@ SERVE_KEYS = (
     "total_p99_ms",
     "window_s",
     "bad_requests",
+    "shed_requests",
     "generation",
     "step",
 )
+# serve window keys added AFTER runs were already archived: absence
+# means a pre-upgrade writer (or a mid-upgrade fleet mixing binaries),
+# not a schema violation — present they ride the all-or-none gate
+OPTIONAL_SERVE_KEYS = ("shed_requests",)
 
 
 def expand_paths(paths: list[str]) -> list[str]:
@@ -294,6 +299,14 @@ def summarize_serve_stream(records: list[dict]) -> dict:
             sum(r.get("bad_requests", 0) for r in windows
                 if _finite(r.get("bad_requests")))
         ),
+        "shed_requests": int(
+            sum(r.get("shed_requests", 0) for r in windows
+                if _finite(r.get("shed_requests")))
+        ),
+        "replica": next(
+            (r["replica"] for r in records if _finite(r.get("replica"))),
+            None,
+        ),
         "reloads": sum(1 for r in records if r.get("event") == "reload"),
         "reload_failures": sum(
             1 for r in records if r.get("event") == "reload_failed"
@@ -304,6 +317,72 @@ def summarize_serve_stream(records: list[dict]) -> dict:
             -1,
         ),
     }
+
+
+def check_fleet_identity(streams: dict) -> list[str]:
+    """Serving-fleet identity gates (docs/SERVING.md "Fleet"), active
+    only where records carry a `replica` stamp (solo serving is
+    untouched):
+
+    - one stream = one replica: a (run_id, rank, gen) serve stream
+      mixing two replica stamps means two processes appended to one
+      file — exactly the interleaving the per-replica layout exists to
+      prevent;
+    - distinct replicas stay distinct: two streams sharing (run_id,
+      rank) but stamping different replicas collide — the fleet failed
+      to give them distinct rank identities and their metrics would
+      merge in every per-rank view;
+    - per-replica restart generations are monotone in time: replica
+      k's `gen` stamps, ordered by ts, never go backwards (a
+      regression means a stale pre-restart process kept writing after
+      its supersessor came up — two live processes on one identity).
+    """
+    problems: list[str] = []
+    # (run_id, rank) -> {replica stamps seen}, and per-(run_id, replica)
+    # the (ts, gen) trail
+    rank_replicas: dict = {}
+    gen_trail: dict = {}
+    for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
+        if kind != "serve":
+            continue
+        reps = {
+            r["replica"] for r in records
+            if isinstance(r.get("replica"), int)
+        }
+        if not reps:
+            continue
+        if len(reps) > 1:
+            problems.append(
+                f"run {run_id} rank {rank} [serve] gen {gen}: one stream "
+                f"mixes replica stamps {sorted(reps)}"
+            )
+        rank_replicas.setdefault((run_id, rank), set()).update(reps)
+        for r in records:
+            rep = r.get("replica")
+            if isinstance(rep, int) and _finite(r.get("ts")):
+                gen_trail.setdefault((run_id, rep), []).append(
+                    (r["ts"], gen)
+                )
+    for (run_id, rank), reps in sorted(rank_replicas.items(), key=str):
+        if len(reps) > 1:
+            problems.append(
+                f"run {run_id} rank {rank}: distinct replicas "
+                f"{sorted(reps)} collide on one rank stamp — their serve "
+                "streams would merge in every per-rank view"
+            )
+    for (run_id, rep), trail in sorted(gen_trail.items(), key=str):
+        trail.sort(key=lambda tg: tg[0])
+        last = -1
+        for ts, g in trail:
+            if g < last:
+                problems.append(
+                    f"run {run_id} replica {rep}: restart generation went "
+                    f"backwards ({last} -> {g}) — a stale pre-restart "
+                    "process is still writing"
+                )
+                break
+            last = g
+    return problems
 
 
 def check_streams(streams: dict, files: list[str]) -> list[str]:
@@ -343,6 +422,7 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                 f"streams ({sorted(seen)}) — ranks of one generation "
                 "launched with different world sizes"
             )
+    problems.extend(check_fleet_identity(streams))
     for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
         tag = f"run {run_id} rank {rank} [{kind}]" + (
             f" gen {gen}" if gen else ""
@@ -433,7 +513,10 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                             f"{tag}: record {i} has a non-string event"
                         )
                 elif s_present:
-                    s_missing = [k for k in SERVE_KEYS if k not in rec]
+                    s_missing = [
+                        k for k in SERVE_KEYS
+                        if k not in rec and k not in OPTIONAL_SERVE_KEYS
+                    ]
                     if s_missing:
                         problems.append(
                             f"{tag}: record {i} has serve keys "
@@ -658,7 +741,7 @@ def render_serve_table(streams: dict) -> str:
     serve stream."""
     header = (
         "run_id", "rank", "gen", "windows", "requests", "rows", "qps",
-        "p50_ms", "p99_ms", "fill", "bad", "reloads", "step",
+        "p50_ms", "p99_ms", "fill", "bad", "shed", "reloads", "step",
     )
 
     def fmt(v):
@@ -672,7 +755,8 @@ def render_serve_table(streams: dict) -> str:
         rows.append((
             run_id, rank, gen, s["windows"], s["requests"], s["rows"],
             s["qps"], s["p50_ms"], s["p99_ms"], s["batch_fill"],
-            s["bad_requests"], s["reloads"], s["last_step"],
+            s["bad_requests"], s["shed_requests"], s["reloads"],
+            s["last_step"],
         ))
     if not rows:
         return ""

@@ -18,10 +18,20 @@ exactly that (flip observed, zero errors, zero drops).
     python tools/serve_bench.py --unix /tmp/serve.sock --rate 500 \
         --data /tmp/test-00000 --bench-json BENCH_SERVE.json
 
+Client-side resilience knobs (the fleet chaos drill's measuring stick,
+tools/smoke_serve_fleet.sh): `--retries N` resends after a connect
+failure or 503 (the server's documented "retry later"), `--deadline-ms`
+bounds one request's total budget, `--hedge-ms` duplicates a slow
+request on a second connection (first answer wins). The record reports
+`retried` / `retry_attempts` / `hedged` / `hedge_wins` /
+`deadline_exceeded`.
+
 The `--bench-json` record is BENCH-shaped ({"metric": "serve_qps", ...}
 with latency percentiles riding along) — the serving analog of
 bench.py's training record, feeding the BENCH_SERVE.json trajectory.
-Exit status: nonzero when any request errored (use in CI gates).
+Exit status: nonzero when any request ULTIMATELY errored — a failure a
+retry absorbed does not fail the run, an unabsorbed one does (use in
+CI gates).
 """
 
 from __future__ import annotations
@@ -92,26 +102,203 @@ class Stats:
         self.requests = 0
         self.rows = 0
         self.errors = 0
+        self.retried = 0  # requests that succeeded only after >= 1 retry
+        self.retry_attempts = 0  # extra sends the retries cost
+        self.hedged = 0  # hedge legs launched
+        self.hedge_wins = 0  # hedge legs that answered first
+        self.deadline_exceeded = 0  # requests abandoned at --deadline-ms
         self.generations: list = []  # (t, gen) observations in order
         self.steps: set = set()
 
-    def ok(self, t: float, lat_s: float, n_rows: int, gen: int, step: int):
+    def ok(self, t: float, lat_s: float, n_rows: int, gen: int, step: int,
+           retries: int = 0):
         with self.lock:
             self.requests += 1
             self.rows += n_rows
             self.latencies.append(lat_s)
+            if retries:
+                self.retried += 1
+                self.retry_attempts += retries
             if not self.generations or self.generations[-1][1] != gen:
                 self.generations.append((t, gen))
             self.steps.add(step)
 
-    def err(self):
+    def err(self, retries: int = 0, deadline: bool = False):
         with self.lock:
             self.requests += 1
             self.errors += 1
+            self.retry_attempts += retries
+            if deadline:
+                self.deadline_exceeded += 1
+
+    def hedge(self, won: bool):
+        with self.lock:
+            self.hedged += 1
+            if won:
+                self.hedge_wins += 1
+
+
+class Client:
+    """One worker's connection + the client-side resilience knobs:
+    `--retries` (reconnect + resend on a connect failure or 503 — the
+    server's documented 'retry later'), `--deadline-ms` (per-request
+    budget the retries must fit in; exceeded = deadline_exceeded
+    error), `--hedge-ms` (a request outstanding that long fires a
+    duplicate on a second connection, first answer wins). A
+    retry-ABSORBED failure is not an error — the nonzero-exit contract
+    counts only requests that ultimately failed."""
+
+    def __init__(self, args):
+        self._args = args
+        self._conn = _connect(args)
+        self._hedge_conn = None
+
+    def close(self):
+        for c in (self._conn, self._hedge_conn):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+
+    def _reset_conns(self):
+        """After a hedge, an abandoned leg may still own its
+        connection's in-flight response — both conns restart clean so
+        the next request never trips CannotSendRequest."""
+        self.close()
+        self._conn = _connect(self._args)
+        self._hedge_conn = None
+
+    def _send_once(self, conn, body: str, timeout_s: float = 0.0):
+        """(status, payload) over one connection; raises on transport
+        failure (caller reconnects). `timeout_s` > 0 bounds the socket
+        wait — the --deadline-ms budget reaches the transport, so a
+        wedged replica costs the budget, not --timeout."""
+        if timeout_s > 0:
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+        conn.request(
+            "POST", "/predict", body, {"Content-Type": "application/json"}
+        )
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    def _send_hedged(self, body: str, stats: Stats, timeout_s: float):
+        """Primary leg on the main connection; after --hedge-ms with no
+        answer, a duplicate on the hedge connection — first answer
+        wins. Transport failures surface as status 599 (retryable)."""
+        import queue
+
+        results: "queue.Queue" = queue.Queue()
+        # timeout_s is the request's remaining --deadline-ms budget:
+        # every wait below is bounded by this absolute point, so a
+        # hedged request never overruns the deadline it measures
+        t_end = time.perf_counter() + timeout_s
+
+        def leg(conn, tag):
+            try:
+                # the budget reaches BOTH legs' sockets — an abandoned
+                # leg against a wedged replica unblocks at the deadline,
+                # not at --timeout, so blocked threads/sockets don't
+                # pile up under sustained wedge
+                results.put((tag, self._send_once(conn, body, timeout_s)))
+            except Exception as e:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                results.put((tag, (599, {"error": str(e)})))
+
+        t = threading.Thread(target=leg, args=(self._conn, "primary"),
+                             daemon=True)
+        t.start()
+        try:
+            tag, got = results.get(
+                timeout=min(self._args.hedge_ms / 1e3, timeout_s)
+            )
+            return got, False
+        except queue.Empty:
+            pass
+        if self._hedge_conn is None:
+            self._hedge_conn = _connect(self._args)
+        threading.Thread(
+            target=leg, args=(self._hedge_conn, "hedge"), daemon=True
+        ).start()
+        first = None
+        for _ in range(2):
+            left = t_end - time.perf_counter()
+            if left <= 0:
+                break
+            try:
+                tag, got = results.get(timeout=left)
+            except queue.Empty:
+                break
+            if got[0] == 200:
+                stats.hedge(won=tag == "hedge")
+                # a leg failed underneath a conn this Client reuses:
+                # both conns get torn down lazily on their own errors
+                return got, True
+            if first is None:
+                first = got
+        if first is None:
+            first = (599, {"error": "hedged request timed out"})
+        stats.hedge(won=False)
+        return first, True
+
+    def send(self, body: str, n_rows: int, stats: Stats):
+        """One logical request through retries/deadline/hedging;
+        records into `stats`. Returns True when it ultimately
+        succeeded."""
+        a = self._args
+        t0 = time.perf_counter()
+        budget = a.deadline_ms / 1e3 if a.deadline_ms > 0 else float("inf")
+        retries_used = 0
+        while True:
+            left = budget - (time.perf_counter() - t0)
+            if left <= 0:
+                stats.err(retries=retries_used, deadline=True)
+                return False
+            try:
+                if a.hedge_ms > 0:
+                    (status, payload), hedged = self._send_hedged(
+                        body, stats, min(left, a.timeout)
+                    )
+                    if hedged:
+                        self._reset_conns()
+                else:
+                    status, payload = self._send_once(
+                        self._conn, body, timeout_s=min(left, a.timeout)
+                    )
+            except Exception:
+                status, payload = 599, None
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = _connect(a)
+            if status == 200 and len(payload.get("pctr", [])) == n_rows:
+                t1 = time.perf_counter()
+                stats.ok(
+                    t1, t1 - t0, n_rows, payload.get("generation", 0),
+                    payload.get("step", -1), retries=retries_used,
+                )
+                return True
+            if status in (503, 599) and retries_used < a.retries:
+                # retryable (load shed / transport); the server asked
+                # for "retry later" — honor it with a short pause (a
+                # zero-delay retry loop would hammer a shedding server
+                # with the exact stampede the 503 tried to stop)
+                retries_used += 1
+                time.sleep(min(a.retry_backoff_ms / 1e3,
+                               max(budget - (time.perf_counter() - t0), 0)))
+                continue
+            stats.err(retries=retries_used)
+            return False
 
 
 def worker(args, rows, stats: Stats, deadline: float, interval_s: float, stop):
-    conn = _connect(args)
+    client = Client(args)
     i = 0
     next_at = time.perf_counter()
     while not stop.is_set():
@@ -125,34 +312,8 @@ def worker(args, rows, stats: Stats, deadline: float, interval_s: float, stop):
             next_at += interval_s
         batch = [rows[(i * 13 + j) % len(rows)] for j in range(args.rows_per_request)]
         i += 1
-        body = json.dumps({"rows": batch})
-        t0 = time.perf_counter()
-        try:
-            conn.request(
-                "POST", "/predict", body, {"Content-Type": "application/json"}
-            )
-            resp = conn.getresponse()
-            payload = json.loads(resp.read())
-            if resp.status != 200 or len(payload.get("pctr", [])) != len(batch):
-                stats.err()
-                continue
-        except Exception:
-            stats.err()
-            try:
-                conn.close()
-            except Exception:
-                pass
-            conn = _connect(args)
-            continue
-        t1 = time.perf_counter()
-        stats.ok(
-            t1, t1 - t0, len(batch), payload.get("generation", 0),
-            payload.get("step", -1),
-        )
-    try:
-        conn.close()
-    except Exception:
-        pass
+        client.send(json.dumps({"rows": batch}), len(batch), stats)
+    client.close()
 
 
 def percentile(xs: list, q: float) -> float:
@@ -177,6 +338,20 @@ def main(argv=None) -> int:
     ap.add_argument("--num-fields", type=int, default=18,
                     help="fields in synthesized rows (ignored with --data)")
     ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--retries", type=int, default=0,
+                    help="resend a request up to N times after a connect "
+                         "failure or 503 (the server's 'retry later'); an "
+                         "absorbed retry is NOT an error — only requests "
+                         "that ultimately fail trip the nonzero exit")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request budget the retries must fit in "
+                         "(0 = none); exceeded = deadline_exceeded error")
+    ap.add_argument("--retry-backoff-ms", type=float, default=50.0,
+                    help="pause before each retry (default 50)")
+    ap.add_argument("--hedge-ms", type=float, default=0.0,
+                    help="fire a duplicate request on a second connection "
+                         "after this long with no answer; first answer "
+                         "wins (0 = off)")
     ap.add_argument("--bench-json", default="",
                     help="write a BENCH-style serve perf JSON here ('-' = stdout)")
     args = ap.parse_args(argv)
@@ -220,6 +395,15 @@ def main(argv=None) -> int:
         "p99_ms": round(percentile(lat, 99) * 1e3, 3),
         "duration_s": round(elapsed, 3),
         "rows_per_request": args.rows_per_request,
+        # client-side resilience trail: failures the retries ABSORBED
+        # (requests that still succeeded), the extra sends they cost,
+        # hedging activity, and requests abandoned at --deadline-ms
+        # (those DO count in errors — an unabsorbed failure)
+        "retried": stats.retried,
+        "retry_attempts": stats.retry_attempts,
+        "hedged": stats.hedged,
+        "hedge_wins": stats.hedge_wins,
+        "deadline_exceeded": stats.deadline_exceeded,
         # the hot-reload trail: distinct generations answered, in
         # arrival order; >1 entries = a reload flipped mid-run
         "generations": gens,

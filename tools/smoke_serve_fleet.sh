@@ -1,0 +1,227 @@
+#!/usr/bin/env bash
+# Serving-fleet chaos gate (docs/SERVING.md "Fleet"):
+#
+# 1. Train a small LR run with committed checkpoints every 10 steps
+#    (10..50), stage step-20 into a serving dir.
+# 2. Start `xflow serve-fleet`: 3 supervised replicas (fixed ports,
+#    per-replica restart generations, staggered hot reload) behind the
+#    health-checked failover router; wait for the ready line.
+# 3. Drive tools/serve_bench.py closed-loop against the ROUTER while
+#    the chaos runs:
+#      - replica 1 SIGKILLs itself after 25 answered batches (the
+#        testing/faults.py serve kill injector — a replica dying
+#        MID-LOAD with responses in flight);
+#      - a CORRUPT step-40 checkpoint is committed mid-load (payload
+#        bitflip with rewritten zip CRCs: only the digest layer can
+#        tell) — every replica's staggered reload must fail, log
+#        reload_failed, and KEEP SERVING step 20;
+#      - then the GOOD step-50 commits and hot-reloads through.
+#    Gate: the client saw ZERO failed requests (router retries absorb
+#    the kill, the walk-back absorbs the corruption) and served steps
+#    flipped 20 -> 50. Emits a BENCH_SERVE-series datapoint
+#    (BENCH_SERVE_FLEET.json).
+# 4. Rejoin: the killed replica's supervised relaunch (restart
+#    generation 1) comes back on its SAME port and the router's
+#    half-open probe closes the circuit — /healthz reports 3/3 healthy;
+#    circuit_open AND circuit_close events are in the router JSONL,
+#    reload_failed in the replica streams, gen-1 records in replica
+#    1's stream.
+# 5. Ordered drain: SIGTERM -> router drains first, then replicas;
+#    exit 0, a drain event in the router JSONL, and
+#    tools/metrics_report.py --check green over the whole fleet run
+#    dir (replica identity + generation gates included).
+#
+# Standalone:    bash tools/smoke_serve_fleet.sh [workdir]
+# From pytest:   tests/test_serve_fleet.py::test_smoke_serve_fleet_script
+set -eu
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# bench datapoint destination: the repo root ONLY standalone (the
+# per-PR record); under pytest it stays in the workdir
+BENCH_OUT="$ROOT/BENCH_SERVE_FLEET.json"
+FLEET_PID=""
+cleanup() {
+    if [ -n "$FLEET_PID" ]; then kill -9 "$FLEET_PID" 2>/dev/null || true; fi
+    # replicas are children of the fleet; sweep any orphans by their
+    # serving dir (unique to this run)
+    pkill -9 -f "serve_ck_fleet" 2>/dev/null || true
+    if [ -n "${TMP_WORK:-}" ]; then rm -rf "$TMP_WORK"; fi
+}
+trap cleanup EXIT
+if [ -z "$WORK" ]; then
+    TMP_WORK="$(mktemp -d)"
+    WORK="$TMP_WORK"
+else
+    BENCH_OUT="$WORK/BENCH_SERVE_FLEET.json"
+fi
+
+export JAX_PLATFORMS=cpu
+# single CPU device (xargs trims; an empty result must UNSET the var —
+# XLA treats a whitespace-only value as a flags FILE to open and aborts)
+XLA_FLAGS="$(printf '%s\n' ${XLA_FLAGS:-} \
+    | grep -v xla_force_host_platform_device_count | xargs || true)"
+if [ -n "$XLA_FLAGS" ]; then export XLA_FLAGS; else unset XLA_FLAGS; fi
+
+MODEL_ARGS=(--model lr --log2-slots 12
+            --set model.num_fields=6 --set data.max_nnz=8)
+SERVE_CK="$WORK/serve_ck_fleet"
+
+# ---- 1. train with a checkpoint trail -------------------------------------
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+python -m xflow_tpu gen-data "$WORK/reqs" --shards 1 --rows 512 \
+    --fields 6 --ids-per-field 50 --seed 9 --truth-seed 0 >/dev/null
+
+python -m xflow_tpu train --train "$WORK/train" "${MODEL_ARGS[@]}" \
+    --epochs 1 --batch-size 64 --checkpoint-dir "$WORK/ck" \
+    --set train.checkpoint_every=10 --set train.pred_dump=false \
+    --set train.log_every=10 >/dev/null 2>"$WORK/train.log"
+
+stage() {  # atomic checkpoint shipping: payload under a temp name, one
+    # rename; $2 = "corrupt" applies a SILENT payload bitflip (zip CRCs
+    # rewritten — only the per-array digests can catch it) BEFORE the
+    # rename, so the fleet sees a committed-but-poisoned checkpoint
+    python - "$WORK/ck" "$SERVE_CK" "$1" "${2:-}" <<'EOF'
+import os, shutil, sys
+src, dst, step, mode = sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4]
+os.makedirs(dst, exist_ok=True)
+tmp = os.path.join(dst, f".staging_{step}")
+if os.path.exists(tmp):
+    shutil.rmtree(tmp)
+shutil.copytree(os.path.join(src, f"step_{step}"), tmp)
+if mode == "corrupt":
+    from xflow_tpu.testing.faults import bitflip_npz_array
+    bitflip_npz_array(os.path.join(tmp, "state.npz"), count=8, seed=3)
+os.replace(tmp, os.path.join(dst, f"step_{step}"))
+EOF
+}
+stage 20
+
+# ---- 2. start the 3-replica supervised fleet ------------------------------
+mkdir -p "$WORK/run_fleet"
+# chaos injector: replica 1 SIGKILLs itself after 25 answered batches,
+# in restart generation 0 only (the relaunch must survive and rejoin)
+export XFLOW_FAULT_SERVE_KILL_BATCHES=25
+export XFLOW_FAULT_SERVE_REPLICA=1
+export XFLOW_FAULT_SERVE_KILL_GEN=0
+
+python -m xflow_tpu serve-fleet --checkpoint-dir "$SERVE_CK" "${MODEL_ARGS[@]}" \
+    --replicas 3 --port 0 --window-ms 3 --max-batch 64 --poll-s 0.3 \
+    --reload-stagger-s 0.5 --retries 3 --deadline-ms 15000 \
+    --eject-failures 2 --circuit-open-s 1 --health-poll-s 0.2 \
+    --run-dir "$WORK/run_fleet" --max-restarts 2 --restart-backoff 0.5 \
+    --no-mesh --set serve.metrics_every_s=1 \
+    >"$WORK/fleet_ready.json" 2>"$WORK/fleet.log" &
+FLEET_PID=$!
+
+for i in $(seq 1 360); do
+    [ -s "$WORK/fleet_ready.json" ] && break
+    kill -0 "$FLEET_PID" 2>/dev/null || {
+        echo "smoke_serve_fleet: fleet died during startup"
+        cat "$WORK/fleet.log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$WORK/fleet_ready.json" ] || {
+    echo "smoke_serve_fleet: fleet never became ready"
+    cat "$WORK/fleet.log"; exit 1; }
+PORT=$(python - "$WORK/fleet_ready.json" <<'EOF'
+import json, sys
+ready = json.load(open(sys.argv[1]))
+assert ready["fleet"] and len(ready["replicas"]) == 3, ready
+assert all(r["step"] == 20 for r in ready["replicas"]), ready
+print(ready["router_port"])
+EOF
+)
+
+# ---- 3. closed-loop bench through the router + the chaos ------------------
+python tools/serve_bench.py --url "http://127.0.0.1:$PORT" \
+    --data "$WORK/reqs-00000" --duration 12 --concurrency 4 \
+    --rows-per-request 4 --retries 3 --deadline-ms 20000 \
+    --bench-json "$BENCH_OUT" \
+    >"$WORK/bench_report.json" 2>"$WORK/bench.log" &
+BENCH_PID=$!
+sleep 3
+stage 40 corrupt   # a poisoned checkpoint commits while requests fly
+sleep 3
+stage 50           # then the good one
+rc=0; wait "$BENCH_PID" || rc=$?
+[ "$rc" -eq 0 ] || {
+    echo "smoke_serve_fleet: loadgen saw unabsorbed failed requests"
+    cat "$WORK/bench_report.json" "$WORK/fleet.log"; exit 1; }
+
+python - "$BENCH_OUT" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["errors"] == 0, rec
+assert rec["deadline_exceeded"] == 0, rec
+steps = rec["steps"]
+assert 20 in steps, f"never served the staged step 20: {rec}"
+assert 50 in steps, f"the good step 50 never hot-reloaded mid-bench: {rec}"
+assert rec["value"] > 0 and rec["p99_ms"] > 0, rec
+print("smoke_serve_fleet: chaos OK "
+      f"(qps {rec['value']}, p50 {rec['p50_ms']}ms, p99 {rec['p99_ms']}ms, "
+      f"{rec['requests']} requests, 0 failed, steps {steps}, "
+      f"client retried {rec['retried']})")
+EOF
+
+# ---- 4. the killed replica restarted and rejoined -------------------------
+python - "$PORT" <<'EOF'
+import http.client, json, sys, time
+
+port = int(sys.argv[1])
+deadline = time.monotonic() + 180
+last = None
+while time.monotonic() < deadline:
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/healthz")
+        last = json.loads(c.getresponse().read())
+        c.close()
+        if last["healthy"] == 3:
+            break
+    except Exception:
+        pass
+    time.sleep(0.5)
+assert last and last["healthy"] == 3, f"killed replica never rejoined: {last}"
+# and the rejoined fleet still answers, at the reloaded step
+c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+steps = set()
+for _ in range(6):
+    c.request("POST", "/predict", json.dumps({"rows": ["0:a 1:b"]}),
+              {"Content-Type": "application/json"})
+    resp = c.getresponse()
+    payload = json.loads(resp.read())
+    assert resp.status == 200, payload
+    steps.add(payload["step"])
+c.close()
+assert steps == {50}, f"post-rejoin fleet not uniformly on step 50: {steps}"
+print("smoke_serve_fleet: rejoin OK (3/3 healthy, all replicas on step 50)")
+EOF
+
+grep -q '"event": "circuit_open"' "$WORK/run_fleet/serve_router.jsonl" || {
+    echo "smoke_serve_fleet: no circuit_open event (kill never ejected)"; exit 1; }
+grep -q '"event": "circuit_close"' "$WORK/run_fleet/serve_router.jsonl" || {
+    echo "smoke_serve_fleet: no circuit_close event (rejoin never closed)"; exit 1; }
+cat "$WORK/run_fleet"/serve_replica*.jsonl | grep -q '"event": "reload_failed"' || {
+    echo "smoke_serve_fleet: no reload_failed (corrupt commit went unnoticed)"; exit 1; }
+grep -q '"gen": 1' "$WORK/run_fleet/serve_replica1.jsonl" || {
+    echo "smoke_serve_fleet: replica 1 has no restart-generation-1 records"; exit 1; }
+
+# ---- 5. ordered drain + telemetry gates -----------------------------------
+kill -TERM "$FLEET_PID"
+rc=0; wait "$FLEET_PID" || rc=$?
+FLEET_PID=""
+[ "$rc" -eq 0 ] || {
+    echo "smoke_serve_fleet: fleet exit $rc"; cat "$WORK/fleet.log"; exit 1; }
+grep -q '"event": "drain"' "$WORK/run_fleet/serve_router.jsonl" || {
+    echo "smoke_serve_fleet: no drain event (router-first shutdown skipped)"; exit 1; }
+
+python tools/metrics_report.py "$WORK/run_fleet" --check
+
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
+echo "smoke_serve_fleet: OK"

@@ -393,6 +393,42 @@ class ServeConfig:
     metrics_every_s: float = 5.0
     # a request unanswered this long gets 503 (the device wedged)
     request_timeout_s: float = 30.0
+    # ---- fleet (serve/fleet.py, `xflow serve-fleet`) -----------------
+    # replica count for `serve-fleet` (each replica is one supervised
+    # `xflow serve` process on its own port; docs/SERVING.md "Fleet")
+    replicas: int = 2
+    # per-replica hot-reload stagger: replica k delays acting on a newer
+    # committed step by k * this many seconds, so the fleet never pauses
+    # every replica for a checkpoint swap at once (0 = no stagger)
+    reload_stagger_s: float = 1.0
+    # ---- router (serve/router.py) ------------------------------------
+    # replica health-check cadence (GET /healthz per replica); the same
+    # loop runs circuit-breaker recovery (the half-open probe)
+    health_poll_s: float = 0.5
+    # consecutive failures (failed forwards or health checks) that eject
+    # a replica into circuit-breaker OPEN state
+    eject_failures: int = 3
+    # how long an OPEN circuit waits before its half-open probe
+    circuit_open_s: float = 2.0
+    # per-request routing budget: retries/hedges must fit inside it;
+    # exhausted = 503 deadline_exceeded back to the client
+    route_deadline_ms: float = 2000.0
+    # transparent retries on a DIFFERENT replica after a connect
+    # failure / 503 (the "retry later" the coalescer's shed asks for)
+    route_retries: int = 2
+    # tail-latency hedging: a request outstanding this long fires a
+    # duplicate at another healthy replica, first answer wins (0 = off)
+    route_hedge_ms: float = 0.0
+    # ---- brownout admission control (serve/coalescer.py) -------------
+    # backlog above high_frac * max_queue_rows sustained for after_s
+    # enters brownout: the coalescing window shrinks by window_factor
+    # (drain faster) and low-priority requests (X-Request-Priority: low)
+    # shed with 503 BEFORE the hard max_queue_rows cliff; backlog below
+    # low_frac * max_queue_rows sustained for after_s exits it.
+    brownout_high_frac: float = 0.5
+    brownout_low_frac: float = 0.25
+    brownout_after_s: float = 0.25
+    brownout_window_factor: float = 0.25
 
 
 @dataclass(frozen=True)

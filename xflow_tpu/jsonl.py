@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -34,7 +35,15 @@ class JsonlAppender:
     def __init__(self, path: str = "", stamp: Optional[dict] = None):
         self._path = path
         self._f = None
+        # appends are serialized: the serving-fleet router writes one
+        # sink from request-handler threads, hedge legs, and the
+        # health loop at once, and an unlocked TextIOWrapper.write can
+        # interleave two records into one damaged line
+        self._lock = threading.Lock()
         self._static = stamp
+        # an explicit stamp may already carry `replica`; None still
+        # resolves lazily (fleet replicas export XFLOW_REPLICA)
+        self._replica_resolved = bool(stamp) and "replica" in stamp
 
     def _stamp(self) -> dict:
         if self._static is None:
@@ -59,24 +68,41 @@ class JsonlAppender:
             from xflow_tpu.telemetry import resolve_world_size
 
             self._static = {**self._static, "world": resolve_world_size()}
+        if not self._replica_resolved:
+            # serving-fleet identity (docs/SERVING.md "Fleet"): replica
+            # index + port, resolved lazily like gen/world. Only fleet
+            # replicas export XFLOW_REPLICA, so solo runs' records are
+            # byte-identical to before — absent keys, not nulls.
+            from xflow_tpu.telemetry import resolve_replica, resolve_replica_port
+
+            self._replica_resolved = True
+            rep = resolve_replica()
+            if rep is not None:
+                extra = {"replica": rep}
+                port = resolve_replica_port()
+                if port is not None:
+                    extra["port"] = port
+                self._static = {**self._static, **extra}
         return self._static
 
     def append(self, record: dict) -> None:
         if not self._path:
             return
-        if self._f is None:
-            parent = os.path.dirname(self._path)
-            if parent:
-                os.makedirs(parent, exist_ok=True)
-            self._f = open(self._path, "a")
-        rec = {"ts": round(time.time(), 6), **self._stamp(), **record}
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        with self._lock:
+            if self._f is None:
+                parent = os.path.dirname(self._path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                self._f = open(self._path, "a")
+            rec = {"ts": round(time.time(), 6), **self._stamp(), **record}
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
 
 def read_jsonl_counted(path: str, warn: bool = True) -> tuple[list, int]:

@@ -99,6 +99,15 @@ def _build_config(args) -> "Config":
         ("serve_max_batch", "serve.max_batch"),
         ("serve_poll_s", "serve.reload_poll_s"),
         ("serve_metrics_path", "serve.metrics_path"),
+        # fleet/router flags (cmd_serve_fleet)
+        ("serve_replicas", "serve.replicas"),
+        ("serve_reload_stagger_s", "serve.reload_stagger_s"),
+        ("serve_route_retries", "serve.route_retries"),
+        ("serve_route_deadline_ms", "serve.route_deadline_ms"),
+        ("serve_route_hedge_ms", "serve.route_hedge_ms"),
+        ("serve_eject_failures", "serve.eject_failures"),
+        ("serve_circuit_open_s", "serve.circuit_open_s"),
+        ("serve_health_poll_s", "serve.health_poll_s"),
     ):
         v = getattr(args, attr, None)
         if v is not None:
@@ -192,6 +201,48 @@ def cmd_serve(args) -> int:
     except (FileNotFoundError, RuntimeError) as e:
         print(f"serve: cannot load a checkpoint: {e}", file=sys.stderr)
         return 1
+
+
+def cmd_serve_fleet(args) -> int:
+    """`xflow serve-fleet`: N supervised `xflow serve` replicas on
+    distinct ports behind the health-checked failover router
+    (serve/fleet.py, docs/SERVING.md "Fleet") — retries, circuit
+    breaking, staggered hot reload, ordered drain. The serving analog
+    of `launch-local --max-restarts`."""
+    cfg = _build_config(args)
+    if not cfg.train.checkpoint_dir:
+        print("serve-fleet: --checkpoint-dir is required", file=sys.stderr)
+        return 2
+
+    from xflow_tpu.serve.fleet import fleet_main
+
+    # the per-replica `xflow serve` argv: every serve-relevant flag the
+    # operator passed, minus the fleet-owned ones (--port is per
+    # replica, --metrics-path per replica under --run-dir)
+    serve_args = ["--checkpoint-dir", args.checkpoint_dir]
+    if args.serve_host:
+        # the replicas must bind the same host the router dials
+        serve_args += ["--host", args.serve_host]
+    if args.model:
+        serve_args += ["--model", args.model]
+    if args.log2_slots is not None:
+        serve_args += ["--log2-slots", str(args.log2_slots)]
+    if args.serve_window_ms is not None:
+        serve_args += ["--window-ms", str(args.serve_window_ms)]
+    if args.serve_max_batch is not None:
+        serve_args += ["--max-batch", str(args.serve_max_batch)]
+    if args.serve_poll_s is not None:
+        serve_args += ["--poll-s", str(args.serve_poll_s)]
+    if args.no_mesh:
+        serve_args += ["--no-mesh"]
+    for item in args.set:
+        serve_args += ["--set", item]
+    return fleet_main(
+        cfg, serve_args, run_dir=args.run_dir,
+        max_restarts=args.max_restarts,
+        restart_backoff=args.restart_backoff,
+        min_uptime_s=args.min_uptime_s,
+    )
 
 
 def cmd_gen_data(args) -> int:
@@ -363,6 +414,77 @@ def main(argv=None) -> int:
     sv.add_argument("--no-mesh", action="store_true", help="force single-device")
     _add_common(sv)
     sv.set_defaults(fn=cmd_serve)
+
+    sf = sub.add_parser(
+        "serve-fleet",
+        help="N supervised serve replicas behind a health-checked "
+             "failover router (retries, circuit breaking, staggered hot "
+             "reload; docs/SERVING.md)",
+    )
+    sf.add_argument("--checkpoint-dir", required=True,
+                    help="run dir holding COMMITTED checkpoints (every "
+                         "replica loads + hot-reloads from it)")
+    sf.add_argument("--model", default=None,
+                    help="model of the checkpoint (lr|fm|mvm|ffm); must match")
+    sf.add_argument("--log2-slots", type=int, default=None)
+    sf.add_argument("--replicas", dest="serve_replicas", type=int, default=None,
+                    help="replica count (default 2); each is one "
+                         "supervised `xflow serve` on its own port")
+    sf.add_argument("--port", dest="serve_port", type=int, default=None,
+                    help="ROUTER port, the client-facing one (default "
+                         "8000; 0 = pick free, reported in the ready "
+                         "line); replicas always pick their own")
+    sf.add_argument("--host", dest="serve_host", default=None)
+    sf.add_argument("--window-ms", dest="serve_window_ms", type=float,
+                    default=None,
+                    help="per-replica microbatch coalescing window")
+    sf.add_argument("--max-batch", dest="serve_max_batch", type=int,
+                    default=None, help="per-replica rows per device batch")
+    sf.add_argument("--poll-s", dest="serve_poll_s", type=float, default=None,
+                    help="per-replica hot-reload poll interval")
+    sf.add_argument("--reload-stagger-s", dest="serve_reload_stagger_s",
+                    type=float, default=None,
+                    help="replica k delays a noticed reload by k * this "
+                         "(default 1.0) — never every replica swapping "
+                         "at once")
+    sf.add_argument("--retries", dest="serve_route_retries", type=int,
+                    default=None,
+                    help="router retries on another replica after a "
+                         "connect failure / 503 (default 2)")
+    sf.add_argument("--deadline-ms", dest="serve_route_deadline_ms",
+                    type=float, default=None,
+                    help="per-request routing budget (default 2000)")
+    sf.add_argument("--hedge-ms", dest="serve_route_hedge_ms", type=float,
+                    default=None,
+                    help="tail-latency hedge delay (default 0 = off)")
+    sf.add_argument("--eject-failures", dest="serve_eject_failures", type=int,
+                    default=None,
+                    help="consecutive failures ejecting a replica into "
+                         "circuit OPEN (default 3)")
+    sf.add_argument("--circuit-open-s", dest="serve_circuit_open_s",
+                    type=float, default=None,
+                    help="OPEN hold before the half-open probe (default 2)")
+    sf.add_argument("--health-poll-s", dest="serve_health_poll_s", type=float,
+                    default=None,
+                    help="replica /healthz poll cadence (default 0.5)")
+    sf.add_argument("--run-dir", default="",
+                    help="collect fleet telemetry here: "
+                         "<run-dir>/serve_replica<k>.jsonl + "
+                         "serve_router.jsonl + replica<k>.log, one shared "
+                         "run_id; summarize with tools/metrics_report.py")
+    sf.add_argument("--max-restarts", type=int, default=0,
+                    help="per-replica supervised restarts after a crash "
+                         "(default 0 = a dead replica stays dead)")
+    sf.add_argument("--restart-backoff", type=float, default=1.0,
+                    help="base seconds between one replica's restarts "
+                         "(exponential + jitter, capped 60s)")
+    sf.add_argument("--min-uptime-s", type=float, default=0.0,
+                    help="a replica dying faster than this stops its "
+                         "supervision (crash loop = config error)")
+    sf.add_argument("--no-mesh", action="store_true",
+                    help="force single-device replicas")
+    _add_common(sf)
+    sf.set_defaults(fn=cmd_serve_fleet)
 
     gd = sub.add_parser("gen-data", help="generate synthetic libffm shards")
     gd.add_argument("out_prefix")

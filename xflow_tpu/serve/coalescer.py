@@ -36,12 +36,46 @@ class RejectedRequest(Exception):
     """A request the coalescer will not queue. `client_error` carries
     the HTTP status class explicitly (serve/server.py): True = the
     CLIENT's mistake (empty/oversized — 400, don't retry unchanged);
-    False = load shedding (backlog full, shutting down — 503, retry
-    later). Either way a visible signal, never a crash."""
+    False = load shedding (backlog full, brownout shed, shutting down —
+    503, retry later). `shed` marks the brownout's priority shed so the
+    server can count it apart from the hard backlog cliff. Either way a
+    visible signal, never a crash."""
 
-    def __init__(self, message: str, client_error: bool = False):
+    def __init__(self, message: str, client_error: bool = False,
+                 shed: bool = False):
         super().__init__(message)
         self.client_error = client_error
+        self.shed = shed
+
+
+@dataclass(frozen=True)
+class BrownoutPolicy:
+    """Admission-control thresholds (docs/SERVING.md "Brownout").
+
+    The hard `max_queue_rows` 503 is a cliff: every submit beyond it
+    fails, whatever its priority, and by the time the backlog is there
+    the p99 is already blown. Brownout is the graded slope before it:
+    backlog >= `high_rows` sustained `after_s` enters brownout — the
+    coalescing window shrinks by `window_factor` (smaller batches,
+    drained sooner) and low-priority submits shed with a retryable 503
+    — and backlog <= `low_rows` sustained `after_s` exits. The
+    hysteresis band (high != low) plus the sustain window keep a bursty
+    backlog from flapping the mode per request."""
+
+    high_rows: int
+    low_rows: int
+    after_s: float = 0.25
+    window_factor: float = 0.25
+
+    @staticmethod
+    def from_config(scfg) -> "BrownoutPolicy":
+        q = int(scfg.max_queue_rows)
+        return BrownoutPolicy(
+            high_rows=max(int(q * scfg.brownout_high_frac), 1),
+            low_rows=max(int(q * scfg.brownout_low_frac), 0),
+            after_s=float(scfg.brownout_after_s),
+            window_factor=float(scfg.brownout_window_factor),
+        )
 
 
 @dataclass
@@ -52,6 +86,7 @@ class PendingRequest:
     slots: list  # per-row int32 arrays
     future: Future = field(default_factory=Future)
     t_submit: float = 0.0
+    priority: int = 0  # < 0 = sheddable under brownout (request header)
 
     @property
     def num_rows(self) -> int:
@@ -65,6 +100,8 @@ class MicroBatcher:
         window_s: float,
         max_queue_rows: int = 8192,
         clock: Callable[[], float] = time.perf_counter,
+        brownout: Optional[BrownoutPolicy] = None,
+        on_brownout: Optional[Callable[[bool, int], None]] = None,
     ):
         if max_rows <= 0:
             raise ValueError(f"max_rows={max_rows}: need >= 1")
@@ -77,17 +114,72 @@ class MicroBatcher:
         self._q: deque = deque()
         self._queued_rows = 0
         self._closed = False
+        # brownout admission control (docs/SERVING.md "Brownout"):
+        # None = off (solo-server default keeps the original cliff-only
+        # behavior); `on_brownout(active, queued_rows)` fires OUTSIDE
+        # the lock on each mode change (telemetry events)
+        self._brownout_policy = brownout
+        self._on_brownout = on_brownout
+        self._brownout = False
+        self._over_since: Optional[float] = None
+        self._under_since: Optional[float] = None
 
     @property
     def queued_rows(self) -> int:
         with self._lock:
             return self._queued_rows
 
-    def submit(self, fields_rows: list, slots_rows: list) -> Future:
+    @property
+    def brownout(self) -> bool:
+        with self._lock:
+            return self._brownout
+
+    def _update_brownout_locked(self, now: float) -> Optional[bool]:
+        """Advance the brownout state machine; returns the new mode on
+        a transition (for the callback), else None. Hysteresis: enter
+        at >= high_rows sustained after_s, exit at <= low_rows
+        sustained after_s — a single burst or a single drained batch
+        must not flap the mode."""
+        p = self._brownout_policy
+        if p is None:
+            return None
+        q = self._queued_rows
+        if not self._brownout:
+            self._under_since = None
+            if q >= p.high_rows:
+                if self._over_since is None:
+                    self._over_since = now
+                if now - self._over_since >= p.after_s:
+                    self._brownout = True
+                    self._over_since = None
+                    return True
+            else:
+                self._over_since = None
+        else:
+            self._over_since = None
+            if q <= p.low_rows:
+                if self._under_since is None:
+                    self._under_since = now
+                if now - self._under_since >= p.after_s:
+                    self._brownout = False
+                    self._under_since = None
+                    return False
+            else:
+                self._under_since = None
+        return None
+
+    def _effective_window_locked(self) -> float:
+        if self._brownout and self._brownout_policy is not None:
+            return self.window_s * self._brownout_policy.window_factor
+        return self.window_s
+
+    def submit(self, fields_rows: list, slots_rows: list,
+               priority: int = 0) -> Future:
         """Queue one request's rows; returns the Future its caller
         blocks on. Raises RejectedRequest (never queues half a request)
-        when the request is empty/oversized, the backlog is full, or
-        the batcher is closed."""
+        when the request is empty/oversized, the backlog is full, the
+        batcher is closed, or brownout is shedding its priority class
+        (priority < 0 while the backlog runs hot)."""
         n = len(slots_rows)
         if n == 0:
             raise RejectedRequest("request has no rows", client_error=True)
@@ -97,21 +189,41 @@ class MicroBatcher:
                 "split the request",
                 client_error=True,
             )
+        now = self._clock()
         req = PendingRequest(
             fields=list(fields_rows), slots=list(slots_rows),
-            t_submit=self._clock(),
+            t_submit=now, priority=int(priority),
         )
-        with self._lock:
-            if self._closed:
-                raise RejectedRequest("server is shutting down")
-            if self._queued_rows + n > self.max_queue_rows:
-                raise RejectedRequest(
-                    f"queue full ({self._queued_rows} rows backlogged, "
-                    f"limit {self.max_queue_rows}); retry later"
-                )
-            self._q.append(req)
-            self._queued_rows += n
-            self._cv.notify_all()
+        flipped = None
+        try:
+            with self._lock:
+                if self._closed:
+                    raise RejectedRequest("server is shutting down")
+                flipped = self._update_brownout_locked(now)
+                if self._brownout and req.priority < 0:
+                    raise RejectedRequest(
+                        f"brownout: shedding low-priority requests "
+                        f"({self._queued_rows} rows backlogged); retry later",
+                        shed=True,
+                    )
+                if self._queued_rows + n > self.max_queue_rows:
+                    raise RejectedRequest(
+                        f"queue full ({self._queued_rows} rows backlogged, "
+                        f"limit {self.max_queue_rows}); retry later"
+                    )
+                self._q.append(req)
+                self._queued_rows += n
+                if flipped is None:
+                    # the append itself may push the backlog over the
+                    # high-water line — start the sustain timer NOW, not
+                    # at the next submit's pre-check
+                    flipped = self._update_brownout_locked(now)
+                self._cv.notify_all()
+        finally:
+            # the mode-change callback runs OUTSIDE the lock (it
+            # appends telemetry), and fires even when this submit shed
+            if flipped is not None and self._on_brownout is not None:
+                self._on_brownout(flipped, self.queued_rows)
         return req.future
 
     def take(self, timeout: Optional[float] = None) -> Optional[list]:
@@ -120,37 +232,51 @@ class MicroBatcher:
         or when closed and drained — the device worker's exit signal.
 
         Release rule: queued rows >= max_rows (size flush), the oldest
-        request has aged past window_s (deadline flush), or the batcher
-        closed (drain everything pending). The popped group is the
-        longest whole-request prefix fitting max_rows."""
+        request has aged past window_s (deadline flush; under brownout
+        the window shrinks by the policy's window_factor — drain the
+        backlog in smaller, sooner batches), or the batcher closed
+        (drain everything pending). The popped group is the longest
+        whole-request prefix fitting max_rows."""
         deadline = None if timeout is None else self._clock() + timeout
+        flipped = None
         with self._lock:
             while True:
                 now = self._clock()
+                if flipped is None:
+                    flipped = self._update_brownout_locked(now)
                 if self._q:
-                    flush_at = self._q[0].t_submit + self.window_s
+                    flush_at = self._q[0].t_submit + self._effective_window_locked()
                     if (
                         self._queued_rows >= self.max_rows
                         or now >= flush_at
                         or self._closed
                     ):
-                        return self._pop_group_locked()
+                        group = self._pop_group_locked()
+                        break
                     if deadline is not None and now >= deadline:
-                        return None  # caller's timeout: window still open
+                        group = None  # caller's timeout: window still open
+                        break
                     # sleep until the window deadline (or the caller's
                     # timeout, or a submit that fills the batch)
                     wake = flush_at if deadline is None else min(flush_at, deadline)
                     self._cv.wait(max(wake - now, 0.0))
                     continue
                 if self._closed:
-                    return None
+                    group = None
+                    break
                 if deadline is not None:
                     left = deadline - now
                     if left <= 0:
-                        return None
+                        group = None
+                        break
                     self._cv.wait(left)
                 else:
                     self._cv.wait()
+        # mode changes observed here (e.g. the backlog draining below
+        # low_rows) report outside the lock, same as submit's
+        if flipped is not None and self._on_brownout is not None:
+            self._on_brownout(flipped, self.queued_rows)
+        return group
 
     def _pop_group_locked(self) -> list:
         group = []

@@ -10,8 +10,12 @@ Window records (one per `every_s`, only when traffic flowed) carry the
 serving SLO view: QPS, rows/s, batch-fill ratio (how well the
 coalescer amortizes the device), and the latency decomposition —
 queue-wait (coalescing delay), device (predict step), total
-(submit -> response ready) p50/p99. `generation`/`step` say which
-model answered the window. Event records ({"event": "reload"|
+(submit -> response ready) p50/p99. `generation`/`step` carry the
+newest model generation this sink has recorded at flush time — a
+monotone high-water mark shared with the event path, so a window
+flushed right after a reload event can never stamp the pre-swap
+pair (the stream stays monotone in file order even though the
+watcher and metrics threads race). Event records ({"event": "reload"|
 "reload_failed"|"start"|"final"}) mark the hot-reload timeline.
 docs/OBSERVABILITY.md documents the schema; metrics_report --check
 gates it (all-or-none keys, monotone generation).
@@ -46,6 +50,7 @@ SERVE_WINDOW_KEYS = (
     "total_p99_ms",
     "window_s",
     "bad_requests",
+    "shed_requests",
     "generation",
     "step",
 )
@@ -70,6 +75,15 @@ class ServeMetrics:
         self._reg = registry or default_registry()
         self._lock = threading.Lock()
         self._win_start = time.perf_counter()
+        # monotone high-water mark of (generation, step) across EVERY
+        # record this sink emitted: the reload event (watcher thread)
+        # and the window flush (metrics thread) race on the appender,
+        # and a window computed against a pre-swap snapshot must not
+        # land AFTER the reload event stamped with the pre-swap pair —
+        # metrics_report --check reads the stream in file order and
+        # gates generation/step monotonicity per restart generation
+        self._seen_gen = -1
+        self._seen_step = -1
         self._reset_window_locked()
 
     @property
@@ -83,6 +97,7 @@ class ServeMetrics:
         self._rows = 0
         self._batches = 0
         self._bad = 0
+        self._shed = 0
         self._queue_waits: list = []
         self._device: list = []
         self._totals: list = []
@@ -112,9 +127,33 @@ class ServeMetrics:
             self._bad += 1
         self._reg.counter("serve.bad_requests").inc()
 
+    def observe_shed(self) -> None:
+        """A brownout priority shed (admission control) — counted apart
+        from bad_requests: a shed is the SERVER's choice under load, a
+        retry-later signal, not a malformed or cliff-rejected request."""
+        with self._lock:
+            self._shed += 1
+        self._reg.counter("serve.shed_requests").inc()
+
+    def _advance_seen_locked(self, generation, step) -> tuple:
+        """Fold (generation, step) into the high-water mark and return
+        the folded pair. The pair moves together: a newer model
+        generation carries its own step; within one generation the
+        runner never regresses the step."""
+        if generation is not None and int(generation) > self._seen_gen:
+            self._seen_gen = int(generation)
+            self._seen_step = int(step) if step is not None else self._seen_step
+        elif step is not None and int(generation or -1) == self._seen_gen:
+            self._seen_step = max(self._seen_step, int(step))
+        return self._seen_gen, self._seen_step
+
     def event(self, name: str, **extra) -> None:
-        """Append an event record immediately (reload timeline)."""
-        self._app.append({**self._kind, "event": name, **extra})
+        """Append an event record immediately (reload timeline). Held
+        under the window lock so the high-water fold and the append are
+        one atomic step relative to `maybe_flush`."""
+        with self._lock:
+            self._advance_seen_locked(extra.get("generation"), extra.get("step"))
+            self._app.append({**self._kind, "event": name, **extra})
 
     # ------------------------------------------------------------- flushing
     def maybe_flush(self, generation: int, step: int, force: bool = False) -> Optional[dict]:
@@ -125,7 +164,7 @@ class ServeMetrics:
             elapsed = now - self._win_start
             if not force and elapsed < self._every:
                 return None
-            if self._batches == 0 and self._bad == 0:
+            if self._batches == 0 and self._bad == 0 and self._shed == 0:
                 self._win_start = now  # idle window: emit nothing
                 return None
             pct = lambda xs, q: (
@@ -151,12 +190,17 @@ class ServeMetrics:
                 "total_p99_ms": pct(self._totals, 99),
                 "window_s": round(elapsed, 3),
                 "bad_requests": self._bad,
-                "generation": int(generation),
-                "step": int(step),
+                "shed_requests": self._shed,
             }
+            # stamp the high-water (generation, step): the caller's pair
+            # is a snapshot that may predate a reload event already in
+            # the file; the append stays under the lock so no fresher
+            # event can slip in between the fold and the write
+            g, s = self._advance_seen_locked(generation, step)
+            rec["generation"], rec["step"] = g, s
             self._reset_window_locked()
             self._win_start = now
-        self._app.append(rec)
+            self._app.append(rec)
         self._reg.gauge("serve.qps").set(rec["qps"])
         if rec["batches"]:
             self._reg.gauge("serve.batch_fill").set(rec["batch_fill"])

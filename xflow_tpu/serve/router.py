@@ -1,0 +1,668 @@
+"""Front-tier failover router for the serving fleet (docs/SERVING.md).
+
+One replica is one process: one SIGKILL, one slow checkpoint swap, or
+one wedged device thread is a user-visible outage. The router is the
+tier that turns N replicas into one service — it speaks the SAME HTTP
+protocol the replicas do (POST /predict, GET /healthz, /stats), so a
+client cannot tell a fleet from a solo server, and it owns four
+failure-handling jobs:
+
+- **Health-checked membership**: a poll loop GETs every replica's
+  /healthz; requests round-robin across healthy replicas only.
+- **Circuit breaking**: `eject_failures` CONSECUTIVE failures (failed
+  forwards or failed health checks) eject a replica into OPEN state —
+  no traffic at all, so a dying replica cannot burn a retry per
+  request. After `circuit_open_s` the next health poll is the
+  HALF_OPEN probe: one probe in flight at a time, success closes the
+  circuit, failure re-opens it.
+- **Transparent retries + deadline**: a connect failure or 503 (the
+  coalescer's documented "retry later" — serve/coalescer.py finally
+  gets its retrier) is retried on a DIFFERENT replica while the
+  per-request `route_deadline_ms` budget lasts; budget exhausted is an
+  honest 503 back to the client.
+- **Tail-latency hedging** (`route_hedge_ms` > 0): a request
+  outstanding that long fires a duplicate at another healthy replica
+  and the first answer wins — the classic p99 amputation for one
+  replica mid-GC/mid-reload.
+
+Everything is socket-level std-lib (http.client / ThreadingHTTPServer)
+and clock-injectable; tests drive the breaker and the routing against
+fake replicas with no checkpoint anywhere (tests/test_serve_fleet.py).
+Telemetry rides the same kind="serve" stream as the replicas: event
+records (circuit_open / circuit_close / hedge / drain / fleet_start /
+fleet_final), stamped rank=-1 like the launcher watchdog.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from xflow_tpu.jsonl import JsonlAppender
+
+# circuit states (docs/SERVING.md "Fleet failure matrix")
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Per-replica consecutive-failure breaker.
+
+    CLOSED: traffic flows; `fail_threshold` CONSECUTIVE failures ->
+    OPEN. OPEN: `allow()` is False until `open_s` elapsed, then the
+    breaker moves to HALF_OPEN and hands out exactly ONE probe
+    permit. HALF_OPEN: probe success -> CLOSED (counters reset), probe
+    failure -> OPEN again with a fresh timer; while the probe is in
+    flight every other `allow()`/`allow_probe()` is False (one probe
+    at a time — a thundering herd of probes IS the outage pattern the
+    breaker exists to stop).
+
+    Thread-safe; `clock` injectable (tests pin transitions without
+    sleeping)."""
+
+    def __init__(
+        self,
+        fail_threshold: int = 3,
+        open_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.fail_threshold = max(int(fail_threshold), 1)
+        self.open_s = float(open_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.opened_count = 0  # lifetime OPEN transitions (telemetry)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _maybe_half_open_locked(self) -> None:
+        if self._state == OPEN and self._clock() - self._opened_at >= self.open_s:
+            self._state = HALF_OPEN
+            self._probe_inflight = False
+
+    def allow(self) -> bool:
+        """May a normal request go to this replica? Only CLOSED — the
+        half-open probe is requested explicitly via allow_probe(), so
+        real traffic never rides a maybe-dead replica."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state == CLOSED
+
+    def allow_probe(self) -> bool:
+        """Claim the single half-open probe permit (the health loop
+        calls this; a True return MUST be followed by record_success or
+        record_failure). CLOSED probes are always allowed — they are
+        ordinary health checks."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def record_success(self, probe: bool = False) -> bool:
+        """Returns True when THIS success closed a non-CLOSED circuit
+        (the caller emits the one matching circuit_close event). A
+        plain (non-probe) success landing while OPEN is a stale
+        in-flight forward launched before the trip — the breaker
+        opened on fresher evidence, so recovery stays gated on the
+        half-open probe instead of a straggler's 200 skipping the
+        open_s hold."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == OPEN and not probe:
+                return False
+            closed_now = self._state != CLOSED
+            self._consecutive = 0
+            self._probe_inflight = False
+            self._state = CLOSED
+            return closed_now
+
+    def record_failure(self, probe: bool = False) -> bool:
+        """Returns True when THIS failure tripped the circuit open
+        (the caller emits one circuit_open event, not one per
+        failure). `probe=True` marks the health loop's sample (the
+        allow_probe permit holder). The mirror of record_success's
+        stale-success guard: a non-probe failure landing while OPEN or
+        HALF_OPEN is a straggler forward launched before the trip —
+        evidence about the OLD process — so it neither steals the
+        probe permit nor restarts the open_s timer (it would push a
+        recovered replica's rejoin back open_s per straggler)."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == HALF_OPEN:
+                if not probe:
+                    return False
+                # failed probe: straight back to OPEN, fresh timer
+                self._probe_inflight = False
+                self._state = OPEN
+                self._opened_at = self._clock()
+                return False
+            if self._state == OPEN:
+                return False
+            self._consecutive += 1
+            if self._consecutive >= self.fail_threshold:
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self.opened_count += 1
+                return True
+            return False
+
+
+class ConnectError(Exception):
+    """A forward that never produced an HTTP response (connect refused,
+    reset, timeout) — always retryable: the request may not even have
+    reached the replica."""
+
+
+class Backend:
+    """One replica as the router sees it: address (mutable — a fleet
+    restart keeps the port, but set_address supports movers), breaker,
+    and a small keep-alive connection pool."""
+
+    def __init__(self, idx: int, host: str, port: int,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.idx = int(idx)
+        self._lock = threading.Lock()
+        self._addr = (host, int(port))
+        self.breaker = breaker or CircuitBreaker()
+        self._pool: deque = deque()
+        self.requests = 0
+        self.failures = 0
+
+    @property
+    def addr(self) -> tuple:
+        with self._lock:
+            return self._addr
+
+    def set_address(self, host: str, port: int) -> None:
+        with self._lock:
+            if (host, int(port)) != self._addr:
+                self._addr = (host, int(port))
+                # stale sockets point at the old address
+                while self._pool:
+                    try:
+                        self._pool.popleft().close()
+                    except Exception:
+                        pass
+
+    def _get_conn(self, timeout: float) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._pool:
+                conn = self._pool.popleft()
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                return conn
+            host, port = self._addr
+        return http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        headers: Optional[dict] = None,
+        timeout: float = 5.0,
+    ) -> tuple[int, bytes]:
+        """One HTTP round trip to this replica. Returns (status, body);
+        raises ConnectError when no response arrived (retryable by
+        construction). The breaker is NOT touched here — routing policy
+        decides what counts as a failure (a 400 is the client's
+        problem, not the replica's)."""
+        conn = self._get_conn(timeout)
+        try:
+            conn.request(method, path, body, headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+        except Exception as e:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            # a connect-level failure means every pooled keep-alive
+            # socket to this replica is suspect (a SIGKILLed replica
+            # leaves up to pool-size dead sockets; each one would burn
+            # a half-open probe and re-open the circuit, stalling
+            # rejoin of the restarted replica by open_s per socket)
+            self.close()
+            raise ConnectError(f"replica {self.idx}: {type(e).__name__}: {e}")
+        self._put_conn(conn)
+        return resp.status, data
+
+    def close(self) -> None:
+        with self._lock:
+            while self._pool:
+                try:
+                    self._pool.popleft().close()
+                except Exception:
+                    pass
+
+
+class Router:
+    """Health-checked round-robin failover over a set of Backends.
+
+    `handle_predict` is socket-free (the HTTP front end in
+    make_router_http_server calls it; tests call it directly)."""
+
+    def __init__(
+        self,
+        backends: list,
+        deadline_ms: float = 2000.0,
+        retries: int = 2,
+        hedge_ms: float = 0.0,
+        health_poll_s: float = 0.5,
+        appender: Optional[JsonlAppender] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.backends = list(backends)
+        self.deadline_s = max(float(deadline_ms), 1.0) / 1e3
+        self.retries = max(int(retries), 0)
+        self.hedge_s = max(float(hedge_ms), 0.0) / 1e3
+        self.health_poll_s = max(float(health_poll_s), 0.05)
+        self._app = appender or JsonlAppender("")
+        self._clock = clock
+        self._rr_lock = threading.Lock()
+        self._rr = 0
+        self._stop = threading.Event()
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="xflow-router-health"
+        )
+        # counters surfaced in /stats and the drain event
+        self._stats_lock = threading.Lock()
+        self.stats = {
+            "requests": 0, "retries": 0, "hedges": 0, "hedge_wins": 0,
+            "deadline_exceeded": 0, "retries_exhausted": 0,
+            "no_backend": 0, "failovers": 0,
+        }
+
+    # ----------------------------------------------------------- telemetry
+    def _event(self, name: str, **extra) -> None:
+        self._app.append({"kind": "serve", "event": name, **extra})
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # ------------------------------------------------------------- health
+    def start(self) -> None:
+        self._health_thread.start()
+
+    def _probe(self, b: Backend) -> None:
+        """One health check = one breaker sample. In HALF_OPEN this IS
+        the recovery probe (allow_probe gates it to one at a time)."""
+        if not b.breaker.allow_probe():
+            return
+        try:
+            status, body = b.request(
+                "GET", "/healthz", timeout=min(self.health_poll_s * 4, 5.0)
+            )
+            ok = status == 200
+        except ConnectError:
+            ok = False
+        if ok:
+            if b.breaker.record_success(probe=True):
+                self._event(
+                    "circuit_close", backend=b.idx, port=b.addr[1],
+                )
+        else:
+            tripped = b.breaker.record_failure(probe=True)
+            if tripped:
+                self._event(
+                    "circuit_open", backend=b.idx, port=b.addr[1],
+                    reason="health_check",
+                )
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_poll_s):
+            for b in self.backends:
+                if self._stop.is_set():
+                    return
+                self._probe(b)
+
+    def healthy(self) -> list:
+        return [b for b in self.backends if b.breaker.allow()]
+
+    def pick(self, exclude: Optional[set] = None) -> Optional[Backend]:
+        """Round-robin over healthy backends, skipping `exclude` (the
+        replicas this request already failed on). Falls back to an
+        excluded-but-healthy backend when nothing else is left — one
+        replica serving is better than refusing outright."""
+        healthy = self.healthy()
+        if not healthy:
+            return None
+        pool = [b for b in healthy if not exclude or b.idx not in exclude]
+        if not pool:
+            pool = healthy
+        with self._rr_lock:
+            self._rr += 1
+            return pool[self._rr % len(pool)]
+
+    # ------------------------------------------------------------- routing
+    def _forward(
+        self, b: Backend, body: bytes, headers: dict, timeout: float
+    ) -> tuple[int, bytes]:
+        b.requests += 1
+        status, data = b.request(
+            "POST", "/predict", body,
+            {"Content-Type": "application/json", **headers},
+            timeout=timeout,
+        )
+        return status, data
+
+    def _try_one(
+        self, b: Backend, body: bytes, headers: dict, timeout: float
+    ) -> tuple[bool, int, bytes]:
+        """(retryable_failure, status, data). Retryable: connect-level
+        failure, 503 (shed/backlog/shutting down — 'retry later' is
+        its documented meaning), or any other 5xx (the replica's
+        fault, and /predict is idempotent). The breaker sees connect
+        failures and non-503 5xx; a 503 ANSWER stays out of it: it
+        proves the replica alive (ejecting shedding replicas would
+        amplify a fleet-wide brownout into a total outage)."""
+        try:
+            status, data = self._forward(b, body, headers, timeout)
+        except ConnectError as e:
+            b.failures += 1
+            if b.breaker.record_failure():
+                self._event(
+                    "circuit_open", backend=b.idx, port=b.addr[1],
+                    reason=f"forward: {e}",
+                )
+            return True, 503, json.dumps({"error": str(e)}).encode()
+        if status == 503:
+            # the replica ANSWERED — it is alive, just shedding
+            # (brownout / backlog / drain). Retry elsewhere, but keep
+            # the breaker out of it: ejecting every replica under a
+            # fleet-wide brownout turns load shedding into a total
+            # "no healthy replica" outage for the normal-priority
+            # traffic the replicas would have accepted. A genuinely
+            # wedged replica still ejects via connect/timeout failures
+            # and failed health checks.
+            b.failures += 1
+            return True, status, data
+        if status >= 500:
+            # a non-503 5xx is the replica FAILING the request (device
+            # error, broken tables after a bad reshard) — retry
+            # elsewhere and feed the breaker, so a replica whose every
+            # predict 500s gets ejected instead of round-robined into
+            # forever (its /healthz can still be 200: the generation
+            # loaded, the device path is what's broken)
+            b.failures += 1
+            if b.breaker.record_failure():
+                self._event(
+                    "circuit_open", backend=b.idx, port=b.addr[1],
+                    reason=f"http_{status}",
+                )
+            return True, status, data
+        if b.breaker.record_success():
+            # a stale HALF_OPEN-window success closed the circuit:
+            # pair the earlier circuit_open event
+            self._event("circuit_close", backend=b.idx, port=b.addr[1])
+        return False, status, data
+
+    def handle_predict(self, body: bytes, headers: Optional[dict] = None
+                       ) -> tuple[int, bytes]:
+        """Route one /predict: pick -> forward -> retry elsewhere on a
+        retryable failure -> hedge when configured -> 503 when the
+        deadline budget runs out. Returns (status, response bytes)."""
+        headers = headers or {}
+        with self._inflight_cv:
+            # admission and the in-flight count move under ONE lock, so
+            # drain() can never observe zero in-flight while an admitted
+            # request has yet to count itself
+            if self._draining:
+                return 503, json.dumps({"error": "router is draining"}).encode()
+            self._inflight += 1
+        try:
+            return self._route(body, headers)
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def _route(self, body: bytes, headers: dict) -> tuple[int, bytes]:
+        self._count("requests")
+        t0 = self._clock()
+        deadline = t0 + self.deadline_s
+        tried: set = set()
+        last: Optional[tuple[int, bytes]] = None
+        prev_idx: Optional[int] = None
+        for attempt in range(self.retries + 1):
+            left = deadline - self._clock()
+            if left <= 0:
+                break
+            b = self.pick(exclude=tried)
+            if b is None:
+                self._count("no_backend")
+                return 503, json.dumps(
+                    {"error": "no healthy replica"}
+                ).encode()
+            tried.add(b.idx)
+            if attempt > 0:
+                self._count("retries")
+                if b.idx != prev_idx:
+                    # a failover is a retry that actually SWITCHED
+                    # replica; pick falls back to the same one when it
+                    # is the only healthy choice left
+                    self._count("failovers")
+            prev_idx = b.idx
+            if self.hedge_s > 0 and left > self.hedge_s:
+                retryable, status, data = self._try_hedged(
+                    b, body, headers, left, tried
+                )
+            else:
+                retryable, status, data = self._try_one(b, body, headers, left)
+            if not retryable:
+                return status, data
+            last = (status, data)
+        # two distinct overload signals with opposite operator fixes:
+        # the budget actually expiring (deadline too small / replicas
+        # too slow) vs every retry burning on a retryable failure with
+        # budget to spare (fleet-wide shedding / dead replicas)
+        if deadline - self._clock() <= 0:
+            self._count("deadline_exceeded")
+        else:
+            self._count("retries_exhausted")
+        if last is not None:
+            return last
+        return 503, json.dumps(
+            {"error": f"deadline exceeded ({self.deadline_s * 1e3:.0f}ms)"}
+        ).encode()
+
+    def _try_hedged(
+        self, primary: Backend, body: bytes, headers: dict,
+        timeout: float, tried: set,
+    ) -> tuple[bool, int, bytes]:
+        """Fire at `primary`; after hedge_s with no answer, fire the
+        SAME request at one more healthy replica — first non-retryable
+        answer wins, a retryable one waits for the other leg. Safe
+        because /predict is idempotent (pure function of the rows)."""
+        import queue
+
+        results: "queue.Queue" = queue.Queue()
+        # the caller's `timeout` IS the remaining deadline budget: every
+        # wait below is bounded by this absolute point, so two wedged
+        # legs cost the client at most the budget, never 2x it
+        t_end = self._clock() + timeout
+
+        def leg(b: Backend, to: float) -> None:
+            results.put((b, self._try_one(b, body, headers, to)))
+
+        threading.Thread(
+            target=leg, args=(primary, timeout), daemon=True
+        ).start()
+        legs = 1
+        hedged = False
+        try:
+            got = results.get(timeout=self.hedge_s)
+        except queue.Empty:
+            got = None
+            hedge_b = self.pick(exclude=tried)
+            if hedge_b is not None:
+                hedged = True
+                tried.add(hedge_b.idx)
+                self._count("hedges")
+                self._event(
+                    "hedge", backend=primary.idx, hedge_backend=hedge_b.idx
+                )
+                threading.Thread(
+                    target=leg, args=(hedge_b, timeout), daemon=True
+                ).start()
+                legs += 1
+        best: Optional[tuple[bool, int, bytes]] = None
+        for i in range(legs):
+            if got is None:
+                left = t_end - self._clock()
+                if left <= 0:
+                    break
+                try:
+                    got = results.get(timeout=left)
+                except queue.Empty:
+                    break
+            b, (retryable, status, data) = got
+            got = None
+            if not retryable:
+                if hedged and b is not primary:
+                    self._count("hedge_wins")
+                return False, status, data
+            best = (retryable, status, data)
+        return best if best is not None else (True, 503, json.dumps(
+            {"error": "hedged request timed out"}
+        ).encode())
+
+    # ------------------------------------------------------ health surface
+    def health(self) -> dict:
+        reps = []
+        for b in self.backends:
+            reps.append({
+                "replica": b.idx,
+                "port": b.addr[1],
+                "state": b.breaker.state,
+                "requests": b.requests,
+                "failures": b.failures,
+            })
+        healthy = sum(1 for r in reps if r["state"] == CLOSED)
+        return {
+            "ok": healthy > 0 and not self._draining,
+            "router": True,
+            "healthy": healthy,
+            "replicas": reps,
+            "draining": self._draining,
+            "inflight": self._inflight,
+        }
+
+    def stats_view(self) -> dict:
+        with self._stats_lock:
+            return {**self.health(), "routing": dict(self.stats)}
+
+    # --------------------------------------------------------------- drain
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Deploy-style shutdown, step 1 (docs/SERVING.md "Fleet
+        drain"): stop ADMITTING (new predicts get a retryable 503 — the
+        LB above has already been told, this is the belt), then wait
+        for every in-flight request to finish. Only AFTER this returns
+        do the replicas get their SIGTERM, so an admitted request
+        always finds its replica alive. Returns False when in-flight
+        requests remained at timeout."""
+        with self._inflight_cv:
+            self._draining = True
+        self._event("drain", inflight=self._inflight)
+        deadline = time.monotonic() + timeout_s
+        with self._inflight_cv:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._inflight_cv.wait(min(left, 0.5))
+        return True
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._health_thread.is_alive():
+            self._health_thread.join(timeout=5.0)
+        for b in self.backends:
+            b.close()
+
+
+def make_router_http_server(router: Router, host: str, port: int):
+    """The router's client-facing HTTP server: same endpoints, same
+    wire shapes as a solo replica (serve/server.py) — /predict is
+    proxied with failover, /healthz and /stats report FLEET health."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from xflow_tpu.serve.server import _QuietDisconnects
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _reply(self, status: int, data: bytes) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._reply(
+                    404,
+                    json.dumps({"error": f"no such endpoint {self.path!r}"}).encode(),
+                )
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                n = 0
+            body = self.rfile.read(n) if n > 0 else b""
+            fwd = {}
+            pr = self.headers.get("X-Request-Priority")
+            if pr is not None:
+                fwd["X-Request-Priority"] = pr
+            status, data = router.handle_predict(body, headers=fwd)
+            self._reply(status, data)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                h = router.health()
+                self._reply(200 if h["ok"] else 503, json.dumps(h).encode())
+            elif self.path == "/stats":
+                self._reply(200, json.dumps(router.stats_view()).encode())
+            else:
+                self._reply(
+                    404,
+                    json.dumps({"error": f"no such endpoint {self.path!r}"}).encode(),
+                )
+
+        def log_message(self, fmt, *args):
+            pass
+
+    class _Server(_QuietDisconnects, ThreadingHTTPServer):
+        daemon_threads = True
+
+    return _Server((host, port), Handler)

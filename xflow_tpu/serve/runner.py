@@ -272,7 +272,16 @@ class ServeRunner:
 class CheckpointWatcher(threading.Thread):
     """Polls the checkpoint dir every `poll_s` for a newer COMMITTED
     step and hot-reloads it off the request path. `on_reload(gen)` /
-    `on_failed()` hooks feed the serve telemetry stream."""
+    `on_failed()` hooks feed the serve telemetry stream.
+
+    `stagger_s` (the fleet's reload stagger, docs/SERVING.md "Fleet"):
+    delay acting on a NEWLY noticed step by this long. A reload pauses
+    the replica's request path for the restore's read time; if every
+    replica of a fleet reloads the instant a step commits, the whole
+    fleet pauses at once — the one synchronized hiccup the fleet
+    exists to remove. `xflow serve-fleet` gives replica k a stagger of
+    k * serve.reload_stagger_s (replica 0 reloads immediately), so at
+    most one replica is swapping at any moment."""
 
     def __init__(
         self,
@@ -280,10 +289,12 @@ class CheckpointWatcher(threading.Thread):
         poll_s: float = 2.0,
         on_reload=None,
         on_failed=None,
+        stagger_s: float = 0.0,
     ):
         super().__init__(daemon=True, name="xflow-serve-watcher")
         self._runner = runner
         self._poll = max(float(poll_s), 0.05)
+        self._stagger_s = max(float(stagger_s), 0.0)
         self._stop_evt = threading.Event()
         self._on_reload = on_reload
         self._on_failed = on_failed
@@ -306,6 +317,8 @@ class CheckpointWatcher(threading.Thread):
                 or latest == self._failed_step
             ):
                 continue
+            if self._stagger_s > 0 and self._stop_evt.wait(self._stagger_s):
+                return  # shutdown mid-stagger: skip the reload
             gen = self._runner.maybe_reload()
             if gen is not None:
                 self._failed_step = None

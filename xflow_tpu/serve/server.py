@@ -35,9 +35,25 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from xflow_tpu.config import Config
-from xflow_tpu.serve.coalescer import MicroBatcher, RejectedRequest, assemble_batch
+from xflow_tpu.serve.coalescer import (
+    BrownoutPolicy,
+    MicroBatcher,
+    RejectedRequest,
+    assemble_batch,
+)
 from xflow_tpu.serve.metrics import ServeMetrics
 from xflow_tpu.serve.runner import BadRequest, CheckpointWatcher, ServeRunner, parse_rows
+
+# request-priority header (docs/SERVING.md "Brownout"): "low" marks a
+# request sheddable under sustained backlog; anything else (or absence)
+# is normal priority. Header-based so retrying proxies/the router can
+# forward it untouched.
+PRIORITY_HEADER = "X-Request-Priority"
+
+
+def parse_priority(value: Optional[str]) -> int:
+    """Header value -> internal priority: < 0 shed under brownout."""
+    return -1 if value is not None and value.strip().lower() == "low" else 0
 
 
 class ServeApp:
@@ -52,16 +68,33 @@ class ServeApp:
         self.metrics = metrics or ServeMetrics(
             scfg.metrics_path, every_s=scfg.metrics_every_s, batch_size=scfg.max_batch
         )
+
+        def on_brownout(active: bool, queued_rows: int) -> None:
+            # the admission-control timeline rides the serve stream
+            # (kind="serve" events, like reload/reload_failed)
+            self.metrics.event(
+                "brownout_enter" if active else "brownout_exit",
+                queued_rows=queued_rows,
+            )
+
         self.batcher = MicroBatcher(
             max_rows=scfg.max_batch,
             window_s=scfg.window_ms / 1e3,
             max_queue_rows=scfg.max_queue_rows,
+            brownout=BrownoutPolicy.from_config(scfg),
+            on_brownout=on_brownout,
         )
         self._timeout_s = scfg.request_timeout_s
         self._stop = threading.Event()
         self._worker = threading.Thread(
             target=self._worker_loop, daemon=True, name="xflow-serve-device"
         )
+        # chaos-drill injectors (testing/faults.serve_faults_from_env):
+        # resolved ONCE here — zero per-batch cost when unset
+        from xflow_tpu.testing.faults import serve_faults_from_env
+
+        self._fault_delay_s, self._fault_kill_batches = serve_faults_from_env()
+        self._batches_served = 0
         self.t_start = time.perf_counter()
 
     def start(self) -> None:
@@ -81,6 +114,10 @@ class ServeApp:
                     self.metrics.maybe_flush(gen.gen, gen.step)
                 continue
             t_batch = time.perf_counter()
+            if self._fault_delay_s > 0:
+                # slow-replica injector: the device "runs slow" without
+                # real overload — circuit/hedge drills use this
+                time.sleep(self._fault_delay_s)
             try:
                 arrays, spans = assemble_batch(
                     group, cfg.serve.max_batch, cfg.data.max_nnz
@@ -116,11 +153,25 @@ class ServeApp:
                 len(group), n_rows, queue_waits, device_s, totals
             )
             self.metrics.maybe_flush(gen.gen, gen.step)
+            self._batches_served += 1
+            if (
+                self._fault_kill_batches
+                and self._batches_served >= self._fault_kill_batches
+            ):
+                # chaos drill: SIGKILL after the Nth answered batch — a
+                # replica dying MID-LOAD with responses in flight (its
+                # supervised relaunch inherits the env generation-gated,
+                # so it survives; testing/faults.hard_kill)
+                from xflow_tpu.testing.faults import hard_kill
+
+                hard_kill()
 
     # ----------------------------------------------------------- app logic
-    def handle_predict(self, body: bytes) -> tuple[int, dict]:
+    def handle_predict(self, body: bytes, priority: int = 0) -> tuple[int, dict]:
         """(http_status, response dict) for one POST /predict body:
-        {"rows": ["field:feat field:feat ...", ...]}."""
+        {"rows": ["field:feat field:feat ...", ...]}. `priority` < 0
+        (the X-Request-Priority: low header) marks the request
+        sheddable under brownout."""
         try:
             payload = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -136,8 +187,13 @@ class ServeApp:
             self.metrics.observe_bad_request()
             return 400, {"error": str(e)}
         try:
-            fut = self.batcher.submit(fields_rows, slots_rows)
+            fut = self.batcher.submit(fields_rows, slots_rows, priority=priority)
         except RejectedRequest as e:
+            if e.shed:
+                # brownout shed is ADMISSION telemetry, not a bad
+                # request: its own counter, still a retryable 503
+                self.metrics.observe_shed()
+                return 503, {"error": str(e)}
             self.metrics.observe_bad_request()
             # oversized request is the CLIENT's error; backlog/shutdown
             # is load shedding (the exception carries the class)
@@ -157,6 +213,7 @@ class ServeApp:
             "generation": gen.gen if gen else 0,
             "step": gen.step if gen else -1,
             "queued_rows": self.batcher.queued_rows,
+            "brownout": self.batcher.brownout,
             "uptime_s": round(time.perf_counter() - self.t_start, 3),
         }
 
@@ -200,7 +257,9 @@ def _make_handler(app: ServeApp):
             except ValueError:
                 n = 0
             body = self.rfile.read(n) if n > 0 else b""
-            status, payload = app.handle_predict(body)
+            status, payload = app.handle_predict(
+                body, priority=parse_priority(self.headers.get(PRIORITY_HEADER))
+            )
             self._reply(status, payload)
 
         def do_GET(self):  # noqa: N802
@@ -296,6 +355,12 @@ def serve_main(cfg: Config, mesh=None, ready_out=None) -> int:
         # compiles lazily on the first batch, after this bind)
         runner.compile_recorder.bind(app.metrics.appender)
     app.metrics.event("start", generation=gen.gen, step=gen.step)
+    try:
+        # the fleet's staggered-reload offset (serve/fleet.py exports
+        # replica k's share; solo servers have no stagger)
+        stagger_s = float(os.environ.get("XFLOW_RELOAD_STAGGER_S", 0) or 0)
+    except ValueError:
+        stagger_s = 0.0
     watcher = CheckpointWatcher(
         runner,
         poll_s=cfg.serve.reload_poll_s,
@@ -303,6 +368,7 @@ def serve_main(cfg: Config, mesh=None, ready_out=None) -> int:
             "reload", generation=g.gen, step=g.step
         ),
         on_failed=lambda: app.metrics.event("reload_failed"),
+        stagger_s=stagger_s,
     )
     app.start()
     watcher.start()

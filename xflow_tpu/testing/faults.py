@@ -21,6 +21,11 @@ real checkpoint dir:
   `abort_after_step`: the in-process crash analog — the elastic
   recovery layer's triggers (supervised auto-restart + exact data
   resume, docs/ROBUSTNESS.md "Elastic recovery").
+- `serve_faults_from_env`: the serving-fleet chaos injectors — a
+  per-batch delay (slow replica: circuit-breaker/hedging drills) and a
+  kill-after-N-batches SIGKILL (replica dying mid-load; generation-
+  gated so the supervised relaunch rejoins) — tools/smoke_serve_fleet.sh
+  drives both through `xflow serve-fleet` (docs/SERVING.md).
 
 The reference has no analog: it neither checkpoints nor validates input
 (SURVEY.md §5 A3), so every one of these faults is either fatal or
@@ -318,6 +323,52 @@ def abort_after_step(trainer, step: int) -> None:
                     )
 
     trainer._coordinated_batches = wrapped
+
+
+# ------------------------------------------------------------- serve faults
+def serve_faults_from_env() -> tuple[float, int]:
+    """(per_batch_delay_s, kill_after_batches) for THIS serve process —
+    the serving-fleet chaos injectors, resolved ONCE at ServeApp
+    construction like the fit-loop faults (zero per-batch cost unset).
+
+    Env contract (tools/smoke_serve_fleet.sh exports these):
+    - XFLOW_FAULT_SERVE_DELAY_S: sleep this long before EVERY device
+      batch — a persistently slow replica (the router's circuit breaker
+      and hedging drills, docs/SERVING.md failure matrix).
+    - XFLOW_FAULT_SERVE_KILL_BATCHES: SIGKILL the process right after
+      the Nth answered batch (responses already in flight) — a replica
+      dying MID-LOAD, deterministic where a timed external kill races
+      the bench.
+    - XFLOW_FAULT_SERVE_REPLICA: restrict either fault to one fleet
+      replica index (default: all; matched against XFLOW_REPLICA via
+      telemetry.resolve_replica).
+    - XFLOW_FAULT_SERVE_KILL_GEN (default 0): only kill in this restart
+      generation — the supervised relaunch (which inherits the env)
+      must survive and REJOIN, not re-die forever (same contract as
+      XFLOW_FAULT_KILL_GEN).
+    """
+    from xflow_tpu.telemetry import resolve_replica, resolve_restart_gen
+
+    def _num(name: str, cast, default):
+        try:
+            return cast(os.environ.get(name, default) or default)
+        except ValueError:
+            return cast(default)
+
+    target = os.environ.get("XFLOW_FAULT_SERVE_REPLICA")
+    if target is not None:
+        try:
+            if int(target) != resolve_replica():
+                return 0.0, 0
+        except (ValueError, TypeError):
+            return 0.0, 0
+    delay = _num("XFLOW_FAULT_SERVE_DELAY_S", float, 0.0)
+    kill = _num("XFLOW_FAULT_SERVE_KILL_BATCHES", int, 0)
+    if kill > 0 and resolve_restart_gen() != _num(
+        "XFLOW_FAULT_SERVE_KILL_GEN", int, 0
+    ):
+        kill = 0
+    return max(delay, 0.0), max(kill, 0)
 
 
 # ------------------------------------------------------------ pacing faults
