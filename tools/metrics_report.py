@@ -53,6 +53,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from xflow_tpu.jsonl import read_jsonl_counted  # noqa: E402
+from xflow_tpu.tracing import (  # noqa: E402
+    BATCH_SPAN_NAME,
+    REQUEST_SPAN_NAMES,
+)
 
 # the step-decomposition keys every window record carries (telemetry
 # .StepTimer.window_record); --check enforces all-or-none
@@ -101,6 +105,15 @@ SERVE_KEYS = (
 # means a pre-upgrade writer (or a mid-upgrade fleet mixing binaries),
 # not a schema violation — present they ride the all-or-none gate
 OPTIONAL_SERVE_KEYS = ("shed_requests",)
+# the key set every kind="span" record carries (xflow_tpu/tracing.py —
+# docs/OBSERVABILITY.md "Request tracing"); `parent` is optional (the
+# root has none), everything else is the assembly contract
+# tools/request_trace.py depends on
+SPAN_KEYS = ("trace", "span", "name", "t0", "dur_ms")
+# request-path span names come from xflow_tpu.tracing (the source of
+# truth): the cross-stream parenting gates below apply to those;
+# operational spans — reload/checkpoint_save/… — are one-span traces
+# and exempt
 
 
 def expand_paths(paths: list[str]) -> list[str]:
@@ -339,11 +352,13 @@ def check_fleet_identity(streams: dict) -> list[str]:
     """
     problems: list[str] = []
     # (run_id, rank) -> {replica stamps seen}, and per-(run_id, replica)
-    # the (ts, gen) trail
+    # the (ts, gen) trail. Span streams ride the same identity gates:
+    # "no span crosses replica stamps" is this one-stream-one-replica
+    # rule applied to kind="span".
     rank_replicas: dict = {}
     gen_trail: dict = {}
     for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
-        if kind != "serve":
+        if kind not in ("serve", "span"):
             continue
         reps = {
             r["replica"] for r in records
@@ -353,7 +368,7 @@ def check_fleet_identity(streams: dict) -> list[str]:
             continue
         if len(reps) > 1:
             problems.append(
-                f"run {run_id} rank {rank} [serve] gen {gen}: one stream "
+                f"run {run_id} rank {rank} [{kind}] gen {gen}: one stream "
                 f"mixes replica stamps {sorted(reps)}"
             )
         rank_replicas.setdefault((run_id, rank), set()).update(reps)
@@ -382,6 +397,67 @@ def check_fleet_identity(streams: dict) -> list[str]:
                 )
                 break
             last = g
+    return problems
+
+
+def check_spans(streams: dict) -> list[str]:
+    """Request-tracing gates (docs/OBSERVABILITY.md "Request tracing"),
+    active only where kind="span" records exist (untraced runs are
+    untouched). Cross-STREAM by design: one request's spans live in the
+    router's file and 1-2 replicas' files, and the whole point of the
+    trace id is that they join back up.
+
+    - every sampled request parents to ONE root: a trace holding two
+      parentless request-path spans is a split tree (two processes both
+      thought they were the request's origin — id reuse or a broken
+      parent header). A trace with NO parentless span is a partial
+      capture (one hop force-emitted while the origin's verdict said
+      drop) — tolerated, request_trace.py reports it as incomplete;
+    - device-batch spans are referenced by >= 1 request span: an
+      unreferenced batch span can never be reached from any request
+      tree — the batch-membership link broke (the dedup emitted the
+      batch but dropped every member's device span);
+    - "no span crosses replica stamps" rides check_fleet_identity
+      (span streams obey the same one-stream-one-replica rule).
+    """
+    problems: list[str] = []
+    # run_id -> {trace: [parentless request spans]}, and the batch-link
+    # reference sets
+    roots: dict = {}
+    batch_ids: dict = {}
+    batch_refs: dict = {}
+    for (run_id, _rank, kind, _gen), records in sorted(streams.items(), key=str):
+        if kind != "span":
+            continue
+        for rec in records:
+            name = rec.get("name")
+            trace = rec.get("trace")
+            if name == BATCH_SPAN_NAME and "span" in rec:
+                batch_ids.setdefault(run_id, {})[rec["span"]] = trace
+                continue
+            if name not in REQUEST_SPAN_NAMES:
+                continue  # operational spans: one-span traces, exempt
+            if "batch" in rec:
+                batch_refs.setdefault(run_id, set()).add(rec["batch"])
+            if not rec.get("parent"):
+                roots.setdefault(run_id, {}).setdefault(trace, []).append(rec)
+    for run_id, traces in sorted(roots.items(), key=str):
+        for trace, rs in sorted(traces.items(), key=str):
+            if len(rs) > 1:
+                problems.append(
+                    f"run {run_id} trace {trace}: {len(rs)} parentless "
+                    f"request spans ({[r.get('name') for r in rs]}) — a "
+                    "sampled request's spans must parent to one root"
+                )
+    for run_id, ids in sorted(batch_ids.items(), key=str):
+        refs = batch_refs.get(run_id, set())
+        for bid, trace in sorted(ids.items(), key=str):
+            if bid not in refs:
+                problems.append(
+                    f"run {run_id} trace {trace}: device_batch span {bid} "
+                    "is referenced by no request span — the "
+                    "batch-membership link broke"
+                )
     return problems
 
 
@@ -423,6 +499,7 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                 "launched with different world sizes"
             )
     problems.extend(check_fleet_identity(streams))
+    problems.extend(check_spans(streams))
     for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
         tag = f"run {run_id} rank {rank} [{kind}]" + (
             f" gen {gen}" if gen else ""
@@ -505,6 +582,18 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                     )
                 else:
                     seen_programs[prog_key] = i
+            if kind == "span":
+                sp_missing = [k for k in SPAN_KEYS if k not in rec]
+                if sp_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks span keys {sp_missing}"
+                    )
+                elif not (_finite(rec["t0"]) and _finite(rec["dur_ms"])
+                          and rec["dur_ms"] >= 0):
+                    problems.append(
+                        f"{tag}: record {i} ({rec.get('name')!r}) has "
+                        "non-numeric t0 or negative dur_ms"
+                    )
             if kind == "serve":
                 s_present = [k for k in SERVE_KEYS if k in rec]
                 if "event" in rec:
@@ -881,7 +970,48 @@ def render_health(streams: dict) -> str:
             )
     else:
         lines.append("  heartbeats: none (train.heartbeat_path off?)")
+    serve_lines = render_serve_latency_split(streams, newest)
+    if serve_lines:
+        lines.extend(serve_lines)
     return "\n".join(lines)
+
+
+def render_serve_latency_split(streams: dict, run_id: str) -> list[str]:
+    """The per-replica queue-wait vs device p99 split (docs/SERVING.md
+    "Telemetry + bench"): the first question request tracing answers in
+    aggregate — is a replica's tail the COALESCER's backlog (queue-wait
+    dominant: shrink the window, add replicas) or the DEVICE (device
+    dominant: batch sizing, model cost)? One line per serve stream of
+    the newest run, with the dominant side named."""
+    fmt = lambda v: f"{v:.4g}" if _finite(v) else "-"
+    out: list[str] = []
+    for (rid, rank, gen), recs in sorted(serve_streams(streams).items(), key=str):
+        if rid != run_id:
+            continue
+        windows = [r for r in recs if "qps" in r]
+        q99s = [r["queue_wait_p99_ms"] for r in windows
+                if _finite(r.get("queue_wait_p99_ms"))]
+        d99s = [r["device_p99_ms"] for r in windows
+                if _finite(r.get("device_p99_ms"))]
+        if not q99s and not d99s:
+            continue
+        rep = next(
+            (r["replica"] for r in recs if _finite(r.get("replica"))), None
+        )
+        q99 = max(q99s) if q99s else float("nan")
+        d99 = max(d99s) if d99s else float("nan")
+        dominant = (
+            "queue-wait" if _finite(q99) and (not _finite(d99) or q99 >= d99)
+            else "device"
+        )
+        label = f"replica {rep}" if rep is not None else f"rank {rank}"
+        out.append(
+            f"    {label} gen {gen}: queue_wait_p99 {fmt(q99)} ms | "
+            f"device_p99 {fmt(d99)} ms  [{dominant}-bound]"
+        )
+    if out:
+        out.insert(0, "  serving latency split (queue-wait vs device p99):")
+    return out
 
 
 # ---------------------------------------------------------------- --regress

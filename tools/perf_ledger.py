@@ -171,9 +171,13 @@ def normalize_serve(path: str, data) -> list[dict]:
         "value": data.get("value"),
         "unit": data.get("unit", ""),
     }
-    for key in ("p50_ms", "p99_ms", "requests", "rows", "errors", "gen_flips"):
+    for key in ("p50_ms", "p99_ms", "requests", "rows", "errors", "gen_flips",
+                "trace_sample_rate", "trace_overhead_pct", "qps_untraced",
+                "qps_traced"):
         if _finite(data.get(key)):
             entry[key] = data[key]
+    if isinstance(data.get("traced"), bool):
+        entry["traced"] = data["traced"]
     return [entry]
 
 
@@ -201,13 +205,16 @@ def collect(root: str, extra: list[str]) -> list[dict]:
             entries.extend(normalize_multichip(path, data))
         elif name == "BENCH_SCALE.json" or "SCALE" in name:
             entries.extend(normalize_scale(path, data))
-        elif name.startswith("BENCH_SERVE"):
+        elif name.startswith(("BENCH_SERVE", "BENCH_TRACE")):
+            # BENCH_TRACE.json is the serve_bench record measured with
+            # request tracing on (tools/smoke_trace.sh): same serve_qps
+            # shape, plus the traced/trace_sample_rate/overhead stamps
             entries.extend(normalize_serve(path, data))
         else:
             entries.extend(normalize_bench(path, data))
 
     for pattern in ("BENCH_r*.json", "BENCH_SCALE*.json", "MULTICHIP_r*.json",
-                    "BENCH_SERVE*.json"):
+                    "BENCH_SERVE*.json", "BENCH_TRACE*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             add(path)
     for path in extra:
@@ -383,11 +390,18 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
         lines.append("")
     serve = [e for e in entries if e["series"] == "serve"]
     if serve:
-        lines += ["## Serving (`BENCH_SERVE.json`)", "",
-                  "| metric | value | p50 ms | p99 ms |", "|---|---|---|---|"]
+        # the source column keys the rows apart: BENCH_SERVE (solo),
+        # BENCH_SERVE_FLEET (router), BENCH_TRACE (tracing on — its
+        # overhead column is the request-tracing cost trajectory,
+        # tools/smoke_trace.sh)
+        lines += ["## Serving (`BENCH_SERVE*.json` / `BENCH_TRACE*.json`)", "",
+                  "| source | metric | value | p50 ms | p99 ms | trace overhead |",
+                  "|---|---|---|---|---|---|"]
         for e in serve:
-            lines.append(f"| {e['metric']} | {_fmt(e['value'])} "
-                         f"| {_fmt(e.get('p50_ms'))} | {_fmt(e.get('p99_ms'))} |")
+            over = e.get("trace_overhead_pct")
+            lines.append(f"| {e['path']} | {e['metric']} | {_fmt(e['value'])} "
+                         f"| {_fmt(e.get('p50_ms'))} | {_fmt(e.get('p99_ms'))} "
+                         f"| {_fmt(over) + '%' if over is not None else '-'} |")
         lines.append("")
     roof = roofline(entries, hbm_gbps)
     if roof:

@@ -1,0 +1,563 @@
+#!/usr/bin/env python3
+"""Assemble per-request traces from a serving run's span streams
+(docs/OBSERVABILITY.md "Request tracing").
+
+The fleet writes `kind="span"` records into per-replica + router JSONL
+streams (xflow_tpu/tracing.py); this tool is the reader that turns
+them back into answers:
+
+    python tools/request_trace.py runs/fleet/            # summary +
+                                                         # critical-path table
+    python tools/request_trace.py runs/fleet --slow 5    # slowest-5 exemplars
+    python tools/request_trace.py runs/fleet --timeline  # reload/checkpoint
+                                                         # overlay
+    python tools/request_trace.py runs/fleet --chrome trace.json
+                                                         # Perfetto-viewable
+    python tools/request_trace.py runs/fleet --json -    # machine-readable
+    python tools/request_trace.py runs/fleet --min-complete 0.99  # CI gate
+
+- **Assembly**: spans group by trace id ACROSS streams (the router's
+  rank=-1 stream + every replica's), parent ids knit them into one
+  tree per request, and `device_batch` spans attach by the `batch=`
+  link request `device` spans carry — the same cross-stream join
+  philosophy as tools/trace_attrib.py, keyed on trace id instead of
+  hlo_module. Orphans (a hedge leg whose losing-side spans outlived
+  their parent's emission) are tolerated and counted, never fatal.
+
+- **Critical path**: each 200-trace decomposes into retry (time burnt
+  on legs before the winning attempt started), network (winning
+  attempt minus the replica-observed server time), parse, queue
+  (backlog wait inside a size-flushed batch), window (coalescing wait
+  inside a deadline-flushed batch), device (the shared batch's device
+  time), and server/router overhead. The printed table shows the
+  aggregate per-hop percentages plus the p50 and p99 EXEMPLARS — real
+  requests, with their trace ids, so "the p99 is queue-bound on
+  replica 1" comes with a receipt you can pull up.
+
+- **Per-replica blame**: the same decomposition grouped by the replica
+  stamp the appender put on every span — a slow replica shows up as
+  its own row with the guilty hop inflated (tools/smoke_trace.sh gates
+  on exactly this).
+
+- **Chrome export** (`--chrome`): trace-event JSON ("X" complete
+  events, one pid per process stream, one tid per request) loadable in
+  Perfetto / chrome://tracing.
+
+Exit codes: 0 ok · 1 no span records · 2 bad paths ·
+4 --min-complete unmet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xflow_tpu.jsonl import read_jsonl_counted  # noqa: E402
+from xflow_tpu.tracing import BATCH_SPAN_NAME, REQUEST_SPAN_NAMES  # noqa: E402
+
+# the critical-path categories, in print order
+CATEGORIES = (
+    "retry", "network", "parse", "queue", "window", "device",
+    "server_other", "router_other",
+)
+
+
+def expand_paths(paths: list) -> list:
+    """Files stay files; directories expand to their sorted *.jsonl
+    (rotated `.jsonl.1` siblings fold in via read_jsonl)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            if not found:
+                raise FileNotFoundError(f"{p!r}: directory holds no *.jsonl files")
+            out.extend(found)
+        elif not os.path.exists(p):
+            raise FileNotFoundError(f"{p!r}: no such file")
+        else:
+            out.append(p)
+    return out
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def load_spans(files: list) -> tuple[list, list, list]:
+    """(request_spans, batch_spans, op_spans) across every file.
+    Request spans are the per-hop names tracing.py emits; batch spans
+    are the shared device_batch records; everything else kind="span"
+    is operational (reload / checkpoint_save / ...)."""
+    request, batch, ops = [], [], []
+    for path in files:
+        for rec in read_jsonl_counted(path, warn=False)[0]:
+            if rec.get("kind") != "span":
+                continue
+            name = rec.get("name")
+            if name == BATCH_SPAN_NAME:
+                batch.append(rec)
+            elif name in REQUEST_SPAN_NAMES:
+                request.append(rec)
+            else:
+                ops.append(rec)
+    return request, batch, ops
+
+
+class TraceTree:
+    """One request's assembled spans."""
+
+    def __init__(self, trace: str, spans: list):
+        self.trace = trace
+        self.spans = spans
+        self.by_id = {s["span"]: s for s in spans if "span" in s}
+        self.children: dict = {}
+        self.roots = []
+        self.orphans = []
+        for s in spans:
+            parent = s.get("parent")
+            if not parent:
+                self.roots.append(s)
+            elif parent in self.by_id:
+                self.children.setdefault(parent, []).append(s)
+            else:
+                # the parent span never emitted (a dropped hop / a
+                # losing hedge leg's abandoned router side) — the
+                # subtree is kept, flagged, and excluded from
+                # completeness
+                self.orphans.append(s)
+
+    @property
+    def root(self):
+        if not self.roots:
+            return None
+        # the router's "request" outranks a replica-local "server" root
+        # (a direct-to-replica request has only the latter)
+        for s in self.roots:
+            if s.get("name") == "request":
+                return s
+        return self.roots[0]
+
+    def kids(self, span: dict, name: str = "") -> list:
+        out = self.children.get(span.get("span"), [])
+        return [s for s in out if not name or s.get("name") == name] if name else out
+
+
+def assemble(request_spans: list) -> dict:
+    """{trace_id: TraceTree} over the request-path spans."""
+    by_trace: dict = {}
+    for s in request_spans:
+        t = s.get("trace")
+        if t:
+            by_trace.setdefault(t, []).append(s)
+    return {t: TraceTree(t, spans) for t, spans in by_trace.items()}
+
+
+def critical_path(tree: TraceTree, batch_by_id: dict) -> dict:
+    """The per-hop decomposition of one trace, in milliseconds.
+
+    Returns {"total_ms", "status", "complete", "replica", categories...}.
+    The math is deliberately first-order: wall-clock t0 anchors align
+    processes on one host, durations are perf-counter-exact within a
+    process, and every residual clamps at zero (clock skew must show up
+    as a shrunken category, never a negative one)."""
+    cats = {c: 0.0 for c in CATEGORIES}
+    root = tree.root
+    if root is None:
+        return {"total_ms": 0.0, "status": None, "complete": False,
+                "replica": None, **cats}
+    total = float(root.get("dur_ms") or 0.0)
+    status = root.get("status")
+    server = None
+    if root.get("name") == "request":
+        attempts = sorted(
+            tree.kids(root, "attempt"), key=lambda s: s.get("t0", 0.0)
+        )
+        # the winner is the 200 leg that FINISHED first (a losing
+        # hedge/retry leg can also land a late 200 via the tracer's
+        # late-span path — picking by start time would decompose the
+        # request against the leg that lost the race)
+        ok_legs = [a for a in attempts if a.get("status") == 200]
+        winning = min(
+            ok_legs,
+            key=lambda a: a.get("t0", 0.0) + float(a.get("dur_ms") or 0.0) / 1e3,
+            default=attempts[-1] if attempts else None,
+        )
+        if winning is not None:
+            # everything before the winning leg started = retry cost
+            # (failed legs, breaker consults, re-picks)
+            cats["retry"] = max(
+                (winning.get("t0", 0.0) - root.get("t0", 0.0)) * 1e3, 0.0
+            )
+            servers = tree.kids(winning, "server")
+            server = servers[0] if servers else None
+            a_dur = float(winning.get("dur_ms") or 0.0)
+            if server is not None:
+                cats["network"] = max(
+                    a_dur - float(server.get("dur_ms") or 0.0), 0.0
+                )
+            else:
+                # the replica side of this leg never emitted: the whole
+                # leg is network/unobserved — honest, and exactly right
+                # when the slowness WAS the network
+                cats["network"] = a_dur
+            cats["router_other"] = max(
+                total - cats["retry"] - a_dur, 0.0
+            )
+    else:
+        server = root
+    complete = False
+    if server is not None:
+        s_dur = float(server.get("dur_ms") or 0.0)
+        seen = 0.0
+        for p in tree.kids(server, "parse"):
+            cats["parse"] += float(p.get("dur_ms") or 0.0)
+            seen += float(p.get("dur_ms") or 0.0)
+        devices = tree.kids(server, "device")
+        for q in tree.kids(server, "queue"):
+            # queue wait splits by WHY the batch flushed: a deadline
+            # flush means the request waited for the coalescing window
+            # (latency floor), a size flush means it waited behind
+            # backlog (overload)
+            flush = None
+            for d in devices:
+                b = batch_by_id.get(d.get("batch"))
+                if b is not None:
+                    flush = b.get("flush")
+                    break
+            key = "window" if flush == "window" else "queue"
+            cats[key] += float(q.get("dur_ms") or 0.0)
+            seen += float(q.get("dur_ms") or 0.0)
+        for d in devices:
+            cats["device"] += float(d.get("dur_ms") or 0.0)
+            seen += float(d.get("dur_ms") or 0.0)
+            if d.get("batch") in batch_by_id:
+                complete = True
+        cats["server_other"] = max(s_dur - seen, 0.0)
+    # a complete tree: one root, the winning chain reached a device
+    # span whose batch link resolves, and nothing dangles mid-chain
+    complete = complete and len(tree.roots) == 1
+    return {
+        "total_ms": total,
+        "status": status,
+        "complete": complete,
+        "replica": (server or {}).get("replica", (server or {}).get("rank")),
+        **cats,
+    }
+
+
+def decompose(trees: dict, batch_spans: list) -> list:
+    batch_by_id = {b["span"]: b for b in batch_spans if "span" in b}
+    rows = []
+    for trace, tree in trees.items():
+        row = critical_path(tree, batch_by_id)
+        row["trace"] = trace
+        rows.append(row)
+    rows.sort(key=lambda r: r["total_ms"])
+    return rows
+
+
+def summarize(rows: list) -> dict:
+    ok = [r for r in rows if r["status"] == 200]
+    complete = [r for r in ok if r["complete"]]
+    agg = {c: sum(r[c] for r in rows) for c in CATEGORIES}
+    total = sum(r["total_ms"] for r in rows)
+    per_replica: dict = {}
+    for r in ok:
+        rep = r["replica"]
+        if rep is None:
+            continue
+        g = per_replica.setdefault(rep, {"requests": 0, "totals": [],
+                                         **{c: 0.0 for c in CATEGORIES}})
+        g["requests"] += 1
+        g["totals"].append(r["total_ms"])
+        for c in CATEGORIES:
+            g[c] += r[c]
+    for g in per_replica.values():
+        ts = sorted(g.pop("totals"))
+        g["p50_ms"] = round(ts[len(ts) // 2], 3) if ts else None
+        g["p99_ms"] = round(ts[min(int(len(ts) * 0.99), len(ts) - 1)], 3) if ts else None
+        for c in CATEGORIES:
+            g[c] = round(g[c] / max(g["requests"], 1), 3)  # mean ms/request
+    return {
+        "traces": len(rows),
+        "ok": len(ok),
+        "complete": len(complete),
+        "complete_frac": round(len(complete) / len(ok), 4) if ok else None,
+        "total_ms_sum": round(total, 3),
+        "per_hop_ms": {c: round(v, 3) for c, v in agg.items()},
+        "per_hop_pct": {
+            c: round(100.0 * v / total, 1) if total > 0 else 0.0
+            for c, v in agg.items()
+        },
+        "per_replica": per_replica,
+    }
+
+
+def _exemplar(rows: list, q: float):
+    ok = [r for r in rows if r["status"] == 200] or rows
+    if not ok:
+        return None
+    return ok[min(int(len(ok) * q), len(ok) - 1)]
+
+
+def render_report(rows: list, summary: dict, slow: int = 0) -> str:
+    lines = [
+        f"request_trace: {summary['traces']} trace(s), {summary['ok']} ok, "
+        f"{summary['complete']} complete root->device-batch trees"
+        + (f" ({summary['complete_frac'] * 100:.1f}% of ok)"
+           if summary["complete_frac"] is not None else "")
+    ]
+    fmt = lambda v: f"{v:.3f}" if _finite(v) else "-"
+    lines.append("")
+    lines.append("critical path (aggregate + exemplars):")
+    header = ("hop",) + tuple(
+        f"{name}" for name in ("agg_ms", "agg_%", "p50_ms", "p99_ms")
+    )
+    p50 = _exemplar(rows, 0.50)
+    p99 = _exemplar(rows, 0.99)
+    table = [header]
+    for c in CATEGORIES:
+        table.append((
+            c,
+            fmt(summary["per_hop_ms"][c]),
+            f"{summary['per_hop_pct'][c]:.1f}",
+            fmt(p50[c]) if p50 else "-",
+            fmt(p99[c]) if p99 else "-",
+        ))
+    table.append((
+        "total",
+        fmt(summary["total_ms_sum"]),
+        "100.0",
+        fmt(p50["total_ms"]) if p50 else "-",
+        fmt(p99["total_ms"]) if p99 else "-",
+    ))
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    for i, r in enumerate(table):
+        lines.append("  " + "  ".join(c.rjust(w) for c, w in zip(r, widths)))
+        if i == 0:
+            lines.append("  " + "  ".join("-" * w for w in widths))
+    if p50 is not None:
+        lines.append(f"  p50 exemplar: trace {p50['trace']}")
+    if p99 is not None:
+        lines.append(f"  p99 exemplar: trace {p99['trace']}")
+    if summary["per_replica"]:
+        lines.append("")
+        lines.append("per-replica (mean ms/request; the blame table):")
+        rep_header = ("replica", "requests", "p50_ms", "p99_ms") + CATEGORIES
+        rep_rows = [tuple(str(h) for h in rep_header)]
+        for rep, g in sorted(summary["per_replica"].items(), key=str):
+            rep_rows.append(tuple(
+                fmt(x) if isinstance(x, float) else str(x)
+                for x in (rep, g["requests"], g["p50_ms"], g["p99_ms"])
+                + tuple(g[c] for c in CATEGORIES)
+            ))
+        widths = [max(len(r[i]) for r in rep_rows) for i in range(len(rep_header))]
+        for i, r in enumerate(rep_rows):
+            lines.append("  " + "  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  " + "  ".join("-" * w for w in widths))
+    if slow > 0:
+        lines.append("")
+        lines.append(f"slowest {slow} exemplar(s):")
+        for r in sorted(rows, key=lambda r: -r["total_ms"])[:slow]:
+            hot = max(CATEGORIES, key=lambda c: r[c])
+            lines.append(
+                f"  {r['total_ms']:9.3f} ms  trace {r['trace']}  "
+                f"status {r['status']}  replica {r['replica']}  "
+                f"hot hop: {hot} ({r[hot]:.3f} ms)"
+            )
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- timeline
+
+
+def render_timeline(rows: list, op_spans: list) -> str:
+    """Operational spans (reloads, checkpoint saves) overlaid against
+    the request latency between them: each op line is followed by the
+    request count / worst total in the interval up to the next op —
+    the 'did the swap spike my p99' view."""
+    ops = sorted(
+        (o for o in op_spans if _finite(o.get("t0"))), key=lambda o: o["t0"]
+    )
+    reqs = sorted(
+        (r for r in rows if _finite(r.get("t0_wall"))), key=lambda r: r["t0_wall"]
+    )
+    if not ops:
+        return "timeline: no operational spans (reload/checkpoint) found"
+    lines = ["timeline (ops overlaid on request latency):"]
+    bounds = [o["t0"] for o in ops] + [float("inf")]
+    t_base = min([ops[0]["t0"]] + ([reqs[0]["t0_wall"]] if reqs else []))
+
+    def interval(lo, hi):
+        window = [r for r in reqs if lo <= r["t0_wall"] < hi]
+        if not window:
+            return "no requests"
+        worst = max(window, key=lambda r: r["total_ms"])
+        return (f"{len(window)} request(s), worst {worst['total_ms']:.3f} ms "
+                f"(trace {worst['trace']})")
+
+    lines.append(f"  [+0.000s] ... {interval(-float('inf'), bounds[0])}")
+    for i, o in enumerate(ops):
+        who = o.get("replica", o.get("rank"))
+        extra = " ".join(
+            f"{k}={o[k]}" for k in ("step", "generation", "bytes") if k in o
+        )
+        lines.append(
+            f"  [+{o['t0'] - t_base:.3f}s] {o.get('name')} "
+            f"(replica/rank {who}, {o.get('dur_ms', 0):.1f} ms{', ' + extra if extra else ''})"
+        )
+        lines.append(f"      then: {interval(bounds[i], bounds[i + 1])}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ chrome export
+
+
+def chrome_events(trees: dict, batch_spans: list, op_spans: list) -> dict:
+    """Trace-event JSON (Perfetto / chrome://tracing): one "X" complete
+    event per span, pid = the emitting process stream (router / replica
+    k / trainer rank), tid = the trace (so one request reads as one
+    row). Timestamps are microseconds relative to the earliest span."""
+    all_spans = [s for t in trees.values() for s in t.spans]
+    all_spans += batch_spans + op_spans
+    ts0 = min((s["t0"] for s in all_spans if _finite(s.get("t0"))),
+              default=0.0)
+
+    pids: dict = {}
+    names: dict = {}
+
+    def pid_of(s: dict) -> int:
+        rep, rank = s.get("replica"), s.get("rank")
+        label = (
+            f"replica {rep}" if rep is not None
+            else ("router" if rank == -1 else f"rank {rank}")
+        )
+        if label not in pids:
+            pids[label] = len(pids) + 1
+            names[pids[label]] = label
+        return pids[label]
+
+    tids: dict = {}
+
+    def tid_of(trace) -> int:
+        if trace not in tids:
+            tids[trace] = len(tids) + 1
+        return tids[trace]
+
+    events = []
+    for s in all_spans:
+        if not (_finite(s.get("t0")) and _finite(s.get("dur_ms"))):
+            continue
+        args = {
+            k: v for k, v in s.items()
+            if k not in ("kind", "t0", "dur_ms", "ts") and _jsonable(v)
+        }
+        events.append({
+            "name": s.get("name", "span"),
+            "cat": "span",
+            "ph": "X",
+            "ts": round((s["t0"] - ts0) * 1e6, 1),
+            "dur": round(s["dur_ms"] * 1e3, 1),
+            "pid": pid_of(s),
+            "tid": tid_of(s.get("trace", "?")),
+            "args": args,
+        })
+    for pid, label in names.items():
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _jsonable(v) -> bool:
+    return isinstance(v, (str, int, float, bool)) or v is None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="assemble request traces + critical paths from span JSONL"
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL file(s) and/or run dir(s)")
+    ap.add_argument("--json", default="", metavar="OUT",
+                    help="machine-readable report ('-' = stdout)")
+    ap.add_argument("--chrome", default="", metavar="OUT.json",
+                    help="export Chrome trace-event JSON (Perfetto-viewable)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="overlay reload/checkpoint spans on request latency")
+    ap.add_argument("--slow", type=int, default=3,
+                    help="print the N slowest exemplars (default 3; 0 = off)")
+    ap.add_argument("--min-complete", type=float, default=0.0,
+                    help="exit 4 unless >= this fraction of ok traces "
+                         "assembled into complete root->device-batch trees "
+                         "(the CI gate; e.g. 0.99)")
+    args = ap.parse_args(argv)
+
+    try:
+        files = expand_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"request_trace: {e}", file=sys.stderr)
+        return 2
+    request_spans, batch_spans, op_spans = load_spans(files)
+    if not request_spans and not op_spans:
+        print(
+            "request_trace: no kind=\"span\" records found (is "
+            "serve.trace_sample_rate > 0?)", file=sys.stderr,
+        )
+        return 1
+
+    trees = assemble(request_spans)
+    rows = decompose(trees, batch_spans)
+    # anchor each row's wall start for the timeline overlay
+    for r in rows:
+        root = trees[r["trace"]].root
+        r["t0_wall"] = root.get("t0") if root else None
+    summary = summarize(rows)
+
+    print(render_report(rows, summary, slow=args.slow))
+    if args.timeline:
+        print()
+        print(render_timeline(rows, op_spans))
+
+    if args.chrome:
+        out = chrome_events(trees, batch_spans, op_spans)
+        with open(args.chrome, "w") as f:
+            json.dump(out, f)
+        print(f"request_trace: wrote {len(out['traceEvents'])} trace events "
+              f"to {args.chrome}")
+
+    if args.json:
+        payload = json.dumps({
+            **summary,
+            "exemplars": {
+                "p50": _exemplar(rows, 0.50),
+                "p99": _exemplar(rows, 0.99),
+            },
+        })
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+
+    if args.min_complete > 0:
+        frac = summary["complete_frac"]
+        if frac is None or frac < args.min_complete:
+            print(
+                f"request_trace: FAIL: complete fraction "
+                f"{frac if frac is not None else 'n/a'} < "
+                f"{args.min_complete}", file=sys.stderr,
+            )
+            return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

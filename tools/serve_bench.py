@@ -43,6 +43,7 @@ import socket
 import sys
 import threading
 import time
+import uuid
 
 
 class UnixHTTPConnection(http.client.HTTPConnection):
@@ -107,6 +108,7 @@ class Stats:
         self.hedged = 0  # hedge legs launched
         self.hedge_wins = 0  # hedge legs that answered first
         self.deadline_exceeded = 0  # requests abandoned at --deadline-ms
+        self.trace_echo_miss = 0  # --trace responses missing the id echo
         self.generations: list = []  # (t, gen) observations in order
         self.steps: set = set()
 
@@ -136,6 +138,10 @@ class Stats:
             self.hedged += 1
             if won:
                 self.hedge_wins += 1
+
+    def echo_miss(self):
+        with self.lock:
+            self.trace_echo_miss += 1
 
 
 class Client:
@@ -169,22 +175,28 @@ class Client:
         self._conn = _connect(self._args)
         self._hedge_conn = None
 
-    def _send_once(self, conn, body: str, timeout_s: float = 0.0):
-        """(status, payload) over one connection; raises on transport
-        failure (caller reconnects). `timeout_s` > 0 bounds the socket
-        wait — the --deadline-ms budget reaches the transport, so a
-        wedged replica costs the budget, not --timeout."""
+    def _send_once(self, conn, body: str, timeout_s: float = 0.0,
+                   trace_id: str = ""):
+        """(status, payload, echoed_trace_id) over one connection;
+        raises on transport failure (caller reconnects). `timeout_s` >
+        0 bounds the socket wait — the --deadline-ms budget reaches the
+        transport, so a wedged replica costs the budget, not
+        --timeout. `trace_id` rides the X-Trace-Id header; the echo is
+        whatever the response header carried ("" = none)."""
         if timeout_s > 0:
             conn.timeout = timeout_s
             if conn.sock is not None:
                 conn.sock.settimeout(timeout_s)
-        conn.request(
-            "POST", "/predict", body, {"Content-Type": "application/json"}
-        )
+        headers = {"Content-Type": "application/json"}
+        if trace_id:
+            headers["X-Trace-Id"] = trace_id
+        conn.request("POST", "/predict", body, headers)
         resp = conn.getresponse()
-        return resp.status, json.loads(resp.read())
+        echo = resp.getheader("X-Trace-Id") or ""
+        return resp.status, json.loads(resp.read()), echo
 
-    def _send_hedged(self, body: str, stats: Stats, timeout_s: float):
+    def _send_hedged(self, body: str, stats: Stats, timeout_s: float,
+                     trace_id: str = ""):
         """Primary leg on the main connection; after --hedge-ms with no
         answer, a duplicate on the hedge connection — first answer
         wins. Transport failures surface as status 599 (retryable)."""
@@ -197,18 +209,32 @@ class Client:
         t_end = time.perf_counter() + timeout_s
 
         def leg(conn, tag):
+            # each leg is its OWN request to the server, so under
+            # --trace the hedge leg carries its own fresh id — two
+            # requests sharing one id would open two root spans and
+            # assemble as a split tree (the metrics_report --check
+            # gate). The echo is verified per leg and normalized to
+            # the caller's id so send()'s round-trip check sees one
+            # verdict whichever leg won.
+            ltid = (uuid.uuid4().hex[:16]
+                    if (trace_id and tag == "hedge") else trace_id)
             try:
                 # the budget reaches BOTH legs' sockets — an abandoned
                 # leg against a wedged replica unblocks at the deadline,
                 # not at --timeout, so blocked threads/sockets don't
                 # pile up under sustained wedge
-                results.put((tag, self._send_once(conn, body, timeout_s)))
+                status, payload, echo = self._send_once(
+                    conn, body, timeout_s, trace_id=ltid
+                )
+                if ltid and echo == ltid:
+                    echo = trace_id  # round trip verified on this leg
+                results.put((tag, (status, payload, echo)))
             except Exception as e:
                 try:
                     conn.close()
                 except Exception:
                     pass
-                results.put((tag, (599, {"error": str(e)})))
+                results.put((tag, (599, {"error": str(e)}, "")))
 
         t = threading.Thread(target=leg, args=(self._conn, "primary"),
                              daemon=True)
@@ -242,14 +268,17 @@ class Client:
             if first is None:
                 first = got
         if first is None:
-            first = (599, {"error": "hedged request timed out"})
+            first = (599, {"error": "hedged request timed out"}, "")
         stats.hedge(won=False)
         return first, True
 
     def send(self, body: str, n_rows: int, stats: Stats):
         """One logical request through retries/deadline/hedging;
         records into `stats`. Returns True when it ultimately
-        succeeded."""
+        succeeded. Under --trace, every TRANSMIT gets a fresh
+        X-Trace-Id (a client-level retry is a new request to the
+        router — one trace id, one root span) and the final response's
+        echo is verified against what was sent."""
         a = self._args
         t0 = time.perf_counter()
         budget = a.deadline_ms / 1e3 if a.deadline_ms > 0 else float("inf")
@@ -259,16 +288,19 @@ class Client:
             if left <= 0:
                 stats.err(retries=retries_used, deadline=True)
                 return False
+            tid = uuid.uuid4().hex[:16] if a.trace else ""
+            echo = ""
             try:
                 if a.hedge_ms > 0:
-                    (status, payload), hedged = self._send_hedged(
-                        body, stats, min(left, a.timeout)
+                    (status, payload, echo), hedged = self._send_hedged(
+                        body, stats, min(left, a.timeout), trace_id=tid
                     )
                     if hedged:
                         self._reset_conns()
                 else:
-                    status, payload = self._send_once(
-                        self._conn, body, timeout_s=min(left, a.timeout)
+                    status, payload, echo = self._send_once(
+                        self._conn, body, timeout_s=min(left, a.timeout),
+                        trace_id=tid,
                     )
             except Exception:
                 status, payload = 599, None
@@ -278,6 +310,12 @@ class Client:
                     pass
                 self._conn = _connect(a)
             if status == 200 and len(payload.get("pctr", [])) == n_rows:
+                if tid and echo != tid:
+                    # the round-trip assert: a 200 that lost (or
+                    # rewrote) its trace id means the id cannot join
+                    # client-side latency to the server-side spans —
+                    # counted, and it fails the run (nonzero exit)
+                    stats.echo_miss()
                 t1 = time.perf_counter()
                 stats.ok(
                     t1, t1 - t0, n_rows, payload.get("generation", 0),
@@ -352,9 +390,21 @@ def main(argv=None) -> int:
                     help="fire a duplicate request on a second connection "
                          "after this long with no answer; first answer "
                          "wins (0 = off)")
+    ap.add_argument("--trace", action="store_true",
+                    help="send a fresh X-Trace-Id on every request and "
+                         "assert the response echoes it (the tracing "
+                         "round-trip gate, docs/OBSERVABILITY.md); an echo "
+                         "miss fails the run")
+    ap.add_argument("--trace-sample-rate", type=float, default=0.0,
+                    help="the server-side serve.trace_sample_rate this run "
+                         "drove (stamped into the bench record so the "
+                         "BENCH_TRACE trajectory notes tracing overhead; "
+                         "> 0 implies --trace)")
     ap.add_argument("--bench-json", default="",
                     help="write a BENCH-style serve perf JSON here ('-' = stdout)")
     args = ap.parse_args(argv)
+    if args.trace_sample_rate > 0:
+        args.trace = True
 
     rows = load_rows(args.data) if args.data else synth_rows(num_fields=args.num_fields)
     stats = Stats()
@@ -404,6 +454,12 @@ def main(argv=None) -> int:
         "hedged": stats.hedged,
         "hedge_wins": stats.hedge_wins,
         "deadline_exceeded": stats.deadline_exceeded,
+        # the tracing trail (--trace): whether ids rode the requests,
+        # the server-side sample rate this run drove (so BENCH_TRACE
+        # datapoints note tracing overhead), and round-trip misses
+        "traced": bool(args.trace),
+        "trace_sample_rate": args.trace_sample_rate,
+        "trace_echo_miss": stats.trace_echo_miss,
         # the hot-reload trail: distinct generations answered, in
         # arrival order; >1 entries = a reload flipped mid-run
         "generations": gens,
@@ -415,7 +471,9 @@ def main(argv=None) -> int:
     if args.bench_json and args.bench_json != "-":  # '-' already printed
         with open(args.bench_json, "w") as f:
             f.write(out + "\n")
-    return 1 if stats.errors else 0
+    # an echo miss is a FAILED round trip even when the predict
+    # succeeded — the trace id is the join key the whole layer is for
+    return 1 if (stats.errors or stats.trace_echo_miss) else 0
 
 
 if __name__ == "__main__":

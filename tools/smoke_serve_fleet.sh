@@ -136,8 +136,12 @@ EOF
 )
 
 # ---- 3. closed-loop bench through the router + the chaos ------------------
+# 16s window: the chaos sequence underneath needs ~11s on a fast run
+# (kill + corrupt-40 walk-back + staggered step-50 reload across 3
+# replicas) and the shared 1.5-core CI runner can stretch every load
+# by seconds — 12s left the gen flip ~1s of margin and flaked
 python tools/serve_bench.py --url "http://127.0.0.1:$PORT" \
-    --data "$WORK/reqs-00000" --duration 12 --concurrency 4 \
+    --data "$WORK/reqs-00000" --duration 16 --concurrency 4 \
     --rows-per-request 4 --retries 3 --deadline-ms 20000 \
     --bench-json "$BENCH_OUT" \
     >"$WORK/bench_report.json" 2>"$WORK/bench.log" &

@@ -1,0 +1,314 @@
+#!/usr/bin/env bash
+# Request-tracing CI gate (docs/OBSERVABILITY.md "Request tracing"):
+#
+# 1. Train a small LR run with committed checkpoints (10..50), stage
+#    step-20 into a serving dir.
+# 2. Overhead A/B — SOLO `xflow serve` (2 processes total: server +
+#    loadgen — a fleet would put 5 processes on a 2-core CI runner and
+#    drown the signal in scheduler noise), three alternating pairs:
+#    off, traced@0.01, ×3. The traced benches send a
+#    fresh X-Trace-Id per request and assert the echo round-trip (an
+#    echo miss fails the bench). Gates:
+#      - the rate-0 run dirs hold ZERO kind="span" records (the rate-0
+#        streams are the pre-tracing streams);
+#      - best-of-pairs overhead = (best_off - best_traced)/best_off,
+#        stamped into BENCH_TRACE.json (qps_untraced / qps_traced /
+#        trace_overhead_pct — the acceptance budget is <2%; CI gates
+#        loosely at <30%: best-of-pairs absorbs contention spikes, and
+#        an accidental always-on hot-path cost still trips it).
+# 3. The diagnosis drill — 2-replica fleet, sample_rate=1.0: replica 1 runs
+#    with a fault-injected 60 ms per-batch delay
+#    (XFLOW_FAULT_SERVE_DELAY_S — the slow-replica chaos injector);
+#    the GOOD step-50 checkpoint commits mid-bench so a staggered hot
+#    reload lands inside the traced window. Gates:
+#      - tools/request_trace.py assembles >= 99% of ok traces into
+#        complete root -> device-batch span trees (--min-complete 0.99);
+#      - the per-replica critical-path table blames the slow replica's
+#        added latency on the correct hops (queue/window/device — the
+#        injected sleep sits inside the device window and backs up the
+#        coalescer queue), with the fast replica as the control row;
+#      - p50/p99 exemplar trace ids exist (the tail you page on comes
+#        with a receipt);
+#      - the Chrome trace-event export is well-formed
+#        (Perfetto-loadable: "X" events + process_name metadata);
+#      - reload spans are on disk and request_trace --timeline overlays
+#        them against request latency;
+#      - tools/metrics_report.py --check is green over the traced run
+#        dir (span schema + one-root-per-trace + batch-link gates).
+# 4. BENCH_TRACE.json flows through tools/perf_ledger.py (the serve
+#    series notes tracing overhead alongside the BENCH_SERVE points).
+#
+# Standalone:    bash tools/smoke_trace.sh [workdir]
+# From pytest:   tests/test_request_trace.py::test_smoke_trace_script
+set -eu
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# bench datapoint destination: the repo root ONLY standalone (the
+# per-PR record); under pytest it stays in the workdir
+BENCH_OUT="$ROOT/BENCH_TRACE.json"
+FLEET_PID=""
+SOLO_PID=""
+cleanup() {
+    if [ -n "$FLEET_PID" ]; then kill -9 "$FLEET_PID" 2>/dev/null || true; fi
+    if [ -n "$SOLO_PID" ]; then kill -9 "$SOLO_PID" 2>/dev/null || true; fi
+    # replicas are children of the fleet; sweep any orphans by their
+    # serving dir (unique to this run)
+    pkill -9 -f "serve_ck_trace" 2>/dev/null || true
+    if [ -n "${TMP_WORK:-}" ]; then rm -rf "$TMP_WORK"; fi
+}
+trap cleanup EXIT
+if [ -z "$WORK" ]; then
+    TMP_WORK="$(mktemp -d)"
+    WORK="$TMP_WORK"
+else
+    BENCH_OUT="$WORK/BENCH_TRACE.json"
+fi
+
+export JAX_PLATFORMS=cpu
+# single CPU device (xargs trims; an empty result must UNSET the var —
+# XLA treats a whitespace-only value as a flags FILE to open and aborts)
+XLA_FLAGS="$(printf '%s\n' ${XLA_FLAGS:-} \
+    | grep -v xla_force_host_platform_device_count | xargs || true)"
+if [ -n "$XLA_FLAGS" ]; then export XLA_FLAGS; else unset XLA_FLAGS; fi
+
+MODEL_ARGS=(--model lr --log2-slots 12
+            --set model.num_fields=6 --set data.max_nnz=8)
+SERVE_CK="$WORK/serve_ck_trace"
+
+# ---- 1. train with a checkpoint trail -------------------------------------
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+python -m xflow_tpu gen-data "$WORK/reqs" --shards 1 --rows 512 \
+    --fields 6 --ids-per-field 50 --seed 9 --truth-seed 0 >/dev/null
+
+python -m xflow_tpu train --train "$WORK/train" "${MODEL_ARGS[@]}" \
+    --epochs 1 --batch-size 64 --checkpoint-dir "$WORK/ck" \
+    --set train.checkpoint_every=10 --set train.pred_dump=false \
+    --set train.log_every=10 >/dev/null 2>"$WORK/train.log"
+
+stage() {  # atomic checkpoint shipping: payload under a temp name, one rename
+    python - "$WORK/ck" "$SERVE_CK" "$1" <<'EOF'
+import os, shutil, sys
+src, dst, step = sys.argv[1], sys.argv[2], sys.argv[3]
+os.makedirs(dst, exist_ok=True)
+tmp = os.path.join(dst, f".staging_{step}")
+if os.path.exists(tmp):
+    shutil.rmtree(tmp)
+shutil.copytree(os.path.join(src, f"step_{step}"), tmp)
+os.replace(tmp, os.path.join(dst, f"step_{step}"))
+EOF
+}
+stage 20
+
+# one fleet phase: run_fleet <run_dir> <ready_json> <extra --set args...>
+run_fleet() {
+    local rdir="$1" ready="$2"; shift 2
+    mkdir -p "$rdir"
+    python -m xflow_tpu serve-fleet --checkpoint-dir "$SERVE_CK" \
+        "${MODEL_ARGS[@]}" \
+        --replicas 2 --port 0 --window-ms 3 --max-batch 64 --poll-s 0.3 \
+        --reload-stagger-s 0.3 --retries 2 --deadline-ms 20000 \
+        --health-poll-s 0.2 --run-dir "$rdir" \
+        --no-mesh --set serve.metrics_every_s=1 "$@" \
+        >"$ready" 2>"$rdir/fleet.log" &
+    FLEET_PID=$!
+    for i in $(seq 1 360); do
+        [ -s "$ready" ] && break
+        kill -0 "$FLEET_PID" 2>/dev/null || {
+            echo "smoke_trace: fleet died during startup"
+            cat "$rdir/fleet.log"; exit 1; }
+        sleep 0.5
+    done
+    [ -s "$ready" ] || {
+        echo "smoke_trace: fleet never became ready"
+        cat "$rdir/fleet.log"; exit 1; }
+    PORT=$(python - "$ready" <<'EOF'
+import json, sys
+ready = json.load(open(sys.argv[1]))
+assert ready["fleet"] and len(ready["replicas"]) == 2, ready
+assert all(r["step"] == 20 for r in ready["replicas"]), ready
+print(ready["router_port"])
+EOF
+)
+}
+
+drain_fleet() {
+    kill -TERM "$FLEET_PID"
+    local rc=0; wait "$FLEET_PID" || rc=$?
+    FLEET_PID=""
+    [ "$rc" -eq 0 ] || {
+        echo "smoke_trace: fleet exit $rc"; cat "$1/fleet.log"; exit 1; }
+}
+
+# ---- 2. overhead A/B: solo serve, alternating off/traced pairs ------------
+# one solo bench: solo_bench <label> <bench.json out> <serve --set...> <bench extra...>
+solo_bench() {
+    local label="$1" bjson="$2" serve_extra="$3" bench_extra="$4"
+    local sdir="$WORK/solo_$label"
+    mkdir -p "$sdir"
+    python -m xflow_tpu serve --checkpoint-dir "$SERVE_CK" "${MODEL_ARGS[@]}" \
+        --port 0 --window-ms 3 --max-batch 64 --no-mesh \
+        --metrics-path "$sdir/serve.jsonl" --set serve.metrics_every_s=5 \
+        $serve_extra \
+        >"$sdir/ready.json" 2>"$sdir/serve.log" &
+    SOLO_PID=$!
+    for i in $(seq 1 240); do
+        [ -s "$sdir/ready.json" ] && break
+        kill -0 "$SOLO_PID" 2>/dev/null || {
+            echo "smoke_trace: solo serve ($label) died during startup"
+            cat "$sdir/serve.log"; exit 1; }
+        sleep 0.5
+    done
+    local port
+    port=$(python -c "import json,sys; print(json.load(open(sys.argv[1]))['port'])" \
+        "$sdir/ready.json")
+    python tools/serve_bench.py --url "http://127.0.0.1:$port" \
+        --data "$WORK/reqs-00000" --duration 4 --concurrency 2 \
+        --rows-per-request 4 $bench_extra \
+        --bench-json "$bjson" >"$sdir/report.json" 2>"$sdir/bench.log" || {
+        echo "smoke_trace: solo bench ($label) failed"
+        cat "$sdir/report.json" "$sdir/serve.log"; exit 1; }
+    kill -TERM "$SOLO_PID"; wait "$SOLO_PID" || true
+    SOLO_PID=""
+}
+solo_bench off1 "$WORK/bench_off1.json" "" ""
+solo_bench traced1 "$WORK/bench_traced1.json" \
+    "--set serve.trace_sample_rate=0.01" "--trace-sample-rate 0.01"
+solo_bench off2 "$WORK/bench_off2.json" "" ""
+solo_bench traced2 "$WORK/bench_traced2.json" \
+    "--set serve.trace_sample_rate=0.01" "--trace-sample-rate 0.01"
+solo_bench off3 "$WORK/bench_off3.json" "" ""
+solo_bench traced3 "$WORK/bench_traced3.json" \
+    "--set serve.trace_sample_rate=0.01" "--trace-sample-rate 0.01"
+if grep -q '"kind": "span"' "$WORK"/solo_off*/serve.jsonl; then
+    echo "smoke_trace: rate-0 run emitted span records (must be byte-identical" \
+         "to a pre-tracing stream)"; exit 1
+fi
+
+# ---- 3. slow-replica diagnosis drill at full sampling ---------------------
+export XFLOW_FAULT_SERVE_DELAY_S=0.06
+export XFLOW_FAULT_SERVE_REPLICA=1
+run_fleet "$WORK/run_traced" "$WORK/ready_traced.json" \
+    --set serve.trace_sample_rate=1.0
+python tools/serve_bench.py --url "http://127.0.0.1:$PORT" \
+    --data "$WORK/reqs-00000" --duration 9 --concurrency 4 \
+    --rows-per-request 4 --retries 2 --deadline-ms 20000 \
+    --trace-sample-rate 1.0 --bench-json "$WORK/bench_traced.json" \
+    >"$WORK/bench_traced_report.json" 2>"$WORK/bench_traced.log" &
+BENCH_PID=$!
+sleep 4
+stage 50   # a hot reload lands inside the traced window
+rc=0; wait "$BENCH_PID" || rc=$?
+unset XFLOW_FAULT_SERVE_DELAY_S XFLOW_FAULT_SERVE_REPLICA
+[ "$rc" -eq 0 ] || {
+    echo "smoke_trace: drill bench failed (errors or trace-id echo miss)"
+    cat "$WORK/bench_traced_report.json" "$WORK/run_traced/fleet.log"; exit 1; }
+# the mid-bench commit only has to be NOTICED under load; on a slow CI
+# runner the staggered reload itself may land after the bench window —
+# wait it out before draining (the gate below still requires the span)
+for i in $(seq 1 120); do
+    cat "$WORK/run_traced"/serve_replica*.jsonl 2>/dev/null \
+        | grep -q '"name": "reload"' && break
+    sleep 0.5
+done
+drain_fleet "$WORK/run_traced"
+
+# the assembled answer: critical paths, per-replica blame, exemplars,
+# timeline overlay, Chrome export — and the >=99%-complete-trees gate
+python tools/request_trace.py "$WORK/run_traced" \
+    --min-complete 0.99 --timeline \
+    --json "$WORK/trace_summary.json" \
+    --chrome "$WORK/chrome_trace.json" >"$WORK/trace_report.txt" || {
+    echo "smoke_trace: request_trace failed its completeness gate"
+    cat "$WORK/trace_report.txt"; exit 1; }
+
+python - "$WORK/trace_summary.json" "$WORK/chrome_trace.json" \
+    "$WORK/trace_report.txt" <<'EOF'
+import json, sys
+s = json.load(open(sys.argv[1]))
+assert s["ok"] > 0 and s["complete_frac"] >= 0.99, s
+per = {str(k): v for k, v in s["per_replica"].items()}
+assert "0" in per and "1" in per, f"blame table lacks a replica: {list(per)}"
+fast, slow = per["0"], per["1"]
+# the injected 60 ms/batch sleep sits between batch formation and the
+# device call: it lands in the DEVICE span and backs the coalescer
+# queue up behind it — the slow replica's queue+window+device mean must
+# carry the fault, with the fast replica as the control
+fast_hop = fast["queue"] + fast["window"] + fast["device"]
+slow_hop = slow["queue"] + slow["window"] + slow["device"]
+assert slow_hop >= fast_hop + 30.0, (
+    f"slow replica not blamed on queue/window/device: "
+    f"slow {slow_hop:.1f}ms vs fast {fast_hop:.1f}ms")
+assert slow["p99_ms"] > fast["p99_ms"], (slow, fast)
+# the tail exemplars come with receipts (trace ids)
+for q in ("p50", "p99"):
+    ex = s["exemplars"][q]
+    assert ex and ex["trace"], f"no {q} exemplar"
+assert s["exemplars"]["p99"]["total_ms"] >= 50.0, s["exemplars"]["p99"]
+# Chrome export: Perfetto-loadable trace-event JSON
+d = json.load(open(sys.argv[2]))
+xs = [e for e in d["traceEvents"] if e["ph"] == "X"]
+ms = [e for e in d["traceEvents"] if e["ph"] == "M"]
+assert xs and ms, (len(xs), len(ms))
+assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+names = {e["args"]["name"] for e in ms}
+assert any(n.startswith("replica") for n in names) and "router" in names, names
+report = open(sys.argv[3]).read()
+assert "per-replica" in report and "p99 exemplar" in report, report[:400]
+print("smoke_trace: drill OK "
+      f"({s['ok']} ok traces, {s['complete_frac']*100:.1f}% complete, "
+      f"slow-replica hop {slow_hop:.1f}ms vs control {fast_hop:.1f}ms, "
+      f"{len(xs)} chrome events)")
+EOF
+
+# reload spans are on disk and the timeline overlays them
+cat "$WORK/run_traced"/serve_replica*.jsonl | grep -q '"name": "reload"' || {
+    echo "smoke_trace: no reload span (hot swap never traced)"; exit 1; }
+grep -q "reload" "$WORK/trace_report.txt" || {
+    echo "smoke_trace: --timeline never overlaid the reload"; exit 1; }
+
+# span schema + one-root-per-trace + batch-link + replica-identity gates
+python tools/metrics_report.py "$WORK/run_traced" --check
+
+# ---- 4. the overhead stamp + the perf ledger ------------------------------
+python - "$BENCH_OUT" "$WORK"/bench_off?.json -- "$WORK"/bench_traced?.json <<'EOF'
+import json, sys
+sep = sys.argv.index("--")
+offs = [json.load(open(p)) for p in sys.argv[2:sep]]
+trcs = [json.load(open(p)) for p in sys.argv[sep + 1:]]
+for off in offs:
+    assert off["traced"] is False and off["errors"] == 0 and off["value"] > 0, off
+for t in trcs:
+    assert t["traced"] is True and t["trace_sample_rate"] == 0.01, t
+    assert t["errors"] == 0 and t["trace_echo_miss"] == 0, t
+# best-of-pairs: on a 2-core CI runner the QPS noise between identical
+# runs dwarfs any real tracing cost; the max of each pair is the run
+# the scheduler left alone, and THOSE are comparable
+best_off = max(offs, key=lambda r: r["value"])
+rec = max(trcs, key=lambda r: r["value"])
+rec["qps_untraced"] = best_off["value"]
+rec["qps_traced"] = rec["value"]
+rec["trace_overhead_pct"] = round(
+    100.0 * (best_off["value"] - rec["value"]) / best_off["value"], 2)
+json.dump(rec, open(sys.argv[1], "w"))
+# the acceptance budget is <2%; the CI gate is loose (<30%) so a noisy
+# shared runner cannot flake it while a hot-path regression still trips
+assert rec["trace_overhead_pct"] < 30.0, rec["trace_overhead_pct"]
+print(f"smoke_trace: overhead OK (untraced {rec['qps_untraced']} qps, "
+      f"traced@0.01 {rec['qps_traced']} qps, "
+      f"overhead {rec['trace_overhead_pct']}%)")
+EOF
+
+# standalone, BENCH_OUT sits in the repo root (the per-PR record);
+# under pytest, in the workdir — the ledger scans wherever it landed
+python tools/perf_ledger.py --root "$(dirname "$BENCH_OUT")" --markdown - \
+    | grep -q "BENCH_TRACE.json" || {
+    echo "smoke_trace: BENCH_TRACE.json never reached the perf ledger"; exit 1; }
+
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
+echo "smoke_trace: OK"

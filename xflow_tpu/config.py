@@ -267,6 +267,18 @@ class TrainConfig:
     # pod-scale eval (AUC error is bounded by bucket width, ~1/buckets).
     eval_buckets: int = -1
     metrics_path: str = ""  # JSONL per-step metrics stream ("" = stdout summary only)
+    # size cap for the metrics JSONL (bytes; 0 = unbounded): past it
+    # the file rolls to ONE <path>.1 sibling (jsonl.JsonlAppender), so
+    # streaming/online trainers that never stop don't grow the stream
+    # with uptime; read_jsonl folds the roll back in file order
+    metrics_max_bytes: int = 0
+    # checkpoint-lifecycle spans (docs/OBSERVABILITY.md "Request
+    # tracing"): every checkpoint save/restore emits one kind="span"
+    # record (start/end + bytes) into the metrics stream, so
+    # tools/request_trace.py --timeline can overlay checkpoint and
+    # hot-reload swaps against request-latency spikes. Off = the
+    # pre-tracing record stream, byte-identical.
+    ckpt_spans: bool = True
     profile_dir: str = ""  # jax.profiler trace output ("" = disabled)
     # programmatic trace window (telemetry.TraceWindow): with profile_dir
     # set and trace_start_step >= 1, the xprof trace starts just before
@@ -391,6 +403,22 @@ class ServeConfig:
     # latency windows + reload events (docs/OBSERVABILITY.md)
     metrics_path: str = ""
     metrics_every_s: float = 5.0
+    # size cap for the serve telemetry/span JSONL (bytes; 0 = unbounded):
+    # past it the file rolls to a single <path>.1 sibling, so a
+    # long-running fleet's streams are bounded at ~2x this
+    # (jsonl.JsonlAppender; read_jsonl folds the roll transparently)
+    metrics_max_bytes: int = 0
+    # ---- request tracing (xflow_tpu/tracing.py, docs/OBSERVABILITY.md
+    # "Request tracing") --------------------------------------------------
+    # head-sampling rate for per-request span capture: each trace id
+    # keeps/drops deterministically from its own hash, so the router
+    # and every replica agree with no coordination. 0 (default) = off —
+    # the serve JSONL output is byte-identical to a pre-tracing build.
+    trace_sample_rate: float = 0.0
+    # tail capture: any request slower than this (router budget or
+    # replica-observed) — and any that errors, sheds, retries, or
+    # hedges — is captured regardless of the sampling rate
+    trace_slow_ms: float = 250.0
     # a request unanswered this long gets 503 (the device wedged)
     request_timeout_s: float = 30.0
     # ---- fleet (serve/fleet.py, `xflow serve-fleet`) -----------------

@@ -19,6 +19,15 @@ without side-channel knowledge. Callers that know their identity pass
 `stamp=`; sinks constructed deep in the data layer resolve it lazily at
 the first append (by then the launcher env / distributed init has
 settled).
+
+Rotation: `max_bytes > 0` caps the live file — an append that would
+push past the cap first rolls the file to a single `<path>.1` sibling
+(overwriting the previous roll) and reopens fresh, all under the same
+append lock, so a long-running serving fleet's span/metrics streams
+are bounded at ~2x max_bytes instead of growing with uptime. Readers
+fold transparently: `read_jsonl(path)` reads `<path>.1` first (the
+older records) then `path`, so file order — and every order-sensitive
+gate metrics_report runs — survives the roll.
 """
 
 from __future__ import annotations
@@ -32,9 +41,14 @@ from typing import Optional
 
 
 class JsonlAppender:
-    def __init__(self, path: str = "", stamp: Optional[dict] = None):
+    def __init__(self, path: str = "", stamp: Optional[dict] = None,
+                 max_bytes: int = 0):
         self._path = path
         self._f = None
+        # size-capped rotation (0 = unbounded, the historical
+        # behavior): the roll happens inside append() under the lock
+        self._max_bytes = max(int(max_bytes), 0)
+        self._size = None  # bytes in the live file; resolved at open
         # appends are serialized: the serving-fleet router writes one
         # sink from request-handler threads, hedge legs, and the
         # health loop at once, and an unlocked TextIOWrapper.write can
@@ -85,18 +99,45 @@ class JsonlAppender:
                 self._static = {**self._static, **extra}
         return self._static
 
+    @property
+    def enabled(self) -> bool:
+        """Whether appends go anywhere ('' path = disabled sink) — lets
+        callers skip work that only feeds this sink (span buffering)."""
+        return bool(self._path)
+
+    def _open_locked(self) -> None:
+        parent = os.path.dirname(self._path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(self._path, "a")
+        self._size = self._f.tell()  # append mode: at end of file
+
     def append(self, record: dict) -> None:
         if not self._path:
             return
         with self._lock:
             if self._f is None:
-                parent = os.path.dirname(self._path)
-                if parent:
-                    os.makedirs(parent, exist_ok=True)
-                self._f = open(self._path, "a")
+                self._open_locked()
             rec = {"ts": round(time.time(), 6), **self._stamp(), **record}
-            self._f.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+            if (
+                self._max_bytes > 0
+                and self._size > 0
+                and self._size + len(line) > self._max_bytes
+            ):
+                # roll: the live file becomes <path>.1 (replacing the
+                # previous roll — two files bound the footprint) and a
+                # fresh live file opens; still under the append lock,
+                # so concurrent appenders never interleave mid-roll
+                self._f.close()
+                try:
+                    os.replace(self._path, self._path + ".1")
+                except OSError:
+                    pass  # rotation is best-effort; appending must not die
+                self._open_locked()
+            self._f.write(line)
             self._f.flush()
+            self._size += len(line)
 
     def close(self) -> None:
         with self._lock:
@@ -105,14 +146,31 @@ class JsonlAppender:
                 self._f = None
 
 
-def read_jsonl_counted(path: str, warn: bool = True) -> tuple[list, int]:
+def read_jsonl_counted(path: str, warn: bool = True,
+                       fold_rotated: bool = True) -> tuple[list, int]:
     """(records, skipped) from a JSONL file, tolerating damage.
 
     A crash mid-append leaves a partial last line (the appender flushes
     per record, but the record itself can be cut); a reader that raises
     on it makes every post-crash report useless. Unparseable lines —
     final or not — are skipped and counted, with one stderr warning per
-    file, never an exception."""
+    file, never an exception.
+
+    Rotation fold (`fold_rotated`, default on): when the appender's
+    size cap rolled older records into `<path>.1`, they are read FIRST
+    so the combined list keeps file order — callers see one logical
+    stream, not a rotation artifact. Reading the `.1` sibling
+    explicitly does not re-fold (no double reads)."""
+    if (
+        fold_rotated
+        and not path.endswith(".1")
+        and os.path.exists(path + ".1")
+    ):
+        records, skipped = read_jsonl_counted(path + ".1", warn=warn,
+                                              fold_rotated=False)
+        live, live_skipped = read_jsonl_counted(path, warn=warn,
+                                                fold_rotated=False)
+        return records + live, skipped + live_skipped
     records: list = []
     skipped = 0
     first_bad = 0
@@ -142,6 +200,6 @@ def read_jsonl_counted(path: str, warn: bool = True) -> tuple[list, int]:
     return records, skipped
 
 
-def read_jsonl(path: str, warn: bool = True) -> list:
+def read_jsonl(path: str, warn: bool = True, fold_rotated: bool = True) -> list:
     """Truncation-tolerant JSONL read (see read_jsonl_counted)."""
-    return read_jsonl_counted(path, warn=warn)[0]
+    return read_jsonl_counted(path, warn=warn, fold_rotated=fold_rotated)[0]

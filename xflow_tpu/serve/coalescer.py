@@ -87,6 +87,12 @@ class PendingRequest:
     future: Future = field(default_factory=Future)
     t_submit: float = 0.0
     priority: int = 0  # < 0 = sheddable under brownout (request header)
+    # request tracing (xflow_tpu/tracing.py): the trace id and the
+    # server-side parent span id ride the queue so the device worker
+    # can emit this request's queue/device spans and link them to the
+    # shared device_batch span. "" = untraced (zero worker-side cost).
+    trace: str = ""
+    span: str = ""
 
     @property
     def num_rows(self) -> int:
@@ -173,13 +179,23 @@ class MicroBatcher:
             return self.window_s * self._brownout_policy.window_factor
         return self.window_s
 
+    @property
+    def effective_window_s(self) -> float:
+        """The coalescing window currently in force — brownout shrinks
+        it by window_factor. Read-only snapshot for telemetry/tracing
+        (the device-batch span's flush classification must judge a
+        deadline flush against the window that actually applied)."""
+        with self._lock:
+            return self._effective_window_locked()
+
     def submit(self, fields_rows: list, slots_rows: list,
-               priority: int = 0) -> Future:
+               priority: int = 0, trace: str = "", span: str = "") -> Future:
         """Queue one request's rows; returns the Future its caller
         blocks on. Raises RejectedRequest (never queues half a request)
         when the request is empty/oversized, the backlog is full, the
         batcher is closed, or brownout is shedding its priority class
-        (priority < 0 while the backlog runs hot)."""
+        (priority < 0 while the backlog runs hot). `trace`/`span` carry
+        the request's tracing identity to the device worker."""
         n = len(slots_rows)
         if n == 0:
             raise RejectedRequest("request has no rows", client_error=True)
@@ -192,7 +208,7 @@ class MicroBatcher:
         now = self._clock()
         req = PendingRequest(
             fields=list(fields_rows), slots=list(slots_rows),
-            t_submit=now, priority=int(priority),
+            t_submit=now, priority=int(priority), trace=trace, span=span,
         )
         flipped = None
         try:

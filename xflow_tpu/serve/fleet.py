@@ -339,6 +339,15 @@ def fleet_main(cfg: Config, serve_args: list, run_dir: str = "",
     router_jsonl = (
         os.path.join(run_dir, "serve_router.jsonl") if run_dir else ""
     )
+    # rank -1 = control-plane stream, the launcher-watchdog
+    # convention (metrics_report exempts it from rank<world); capped
+    # like the replica streams (serve.metrics_max_bytes)
+    router_app = JsonlAppender(
+        router_jsonl, stamp={"rank": -1, "run_id": run_id},
+        max_bytes=scfg.metrics_max_bytes,
+    )
+    from xflow_tpu.tracing import Tracer
+
     router = Router(
         [
             Backend(
@@ -354,9 +363,14 @@ def fleet_main(cfg: Config, serve_args: list, run_dir: str = "",
         retries=scfg.route_retries,
         hedge_ms=scfg.route_hedge_ms,
         health_poll_s=scfg.health_poll_s,
-        # rank -1 = control-plane stream, the launcher-watchdog
-        # convention (metrics_report exempts it from rank<world)
-        appender=JsonlAppender(router_jsonl, stamp={"rank": -1, "run_id": run_id}),
+        appender=router_app,
+        # request tracing: the router is where a fleet's trace ids are
+        # born (docs/OBSERVABILITY.md "Request tracing"); rate 0 = off
+        tracer=Tracer(
+            router_app,
+            sample_rate=scfg.trace_sample_rate,
+            slow_ms=scfg.trace_slow_ms,
+        ),
     )
     router.start()
     try:

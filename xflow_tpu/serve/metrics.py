@@ -67,8 +67,11 @@ class ServeMetrics:
         every_s: float = 5.0,
         batch_size: int = 1,
         registry: Optional[Registry] = None,
+        max_bytes: int = 0,
     ):
-        self._app = JsonlAppender(path, stamp=None)  # lazy rank/run_id
+        # lazy rank/run_id stamp; max_bytes (serve.metrics_max_bytes)
+        # rolls long-running fleets' streams instead of growing forever
+        self._app = JsonlAppender(path, stamp=None, max_bytes=max_bytes)
         self._kind = {"kind": "serve"}
         self._every = max(float(every_s), 0.05)
         self._batch_size = max(int(batch_size), 1)

@@ -43,6 +43,14 @@ from collections import deque
 from typing import Callable, Optional
 
 from xflow_tpu.jsonl import JsonlAppender
+from xflow_tpu.tracing import (
+    FORCE_HEADER,
+    PARENT_HEADER,
+    TRACE_HEADER,
+    Tracer,
+    clean_id,
+    new_id,
+)
 
 # circuit states (docs/SERVING.md "Fleet failure matrix")
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -276,6 +284,7 @@ class Router:
         health_poll_s: float = 0.5,
         appender: Optional[JsonlAppender] = None,
         clock: Callable[[], float] = time.monotonic,
+        tracer: Optional[Tracer] = None,
     ):
         self.backends = list(backends)
         self.deadline_s = max(float(deadline_ms), 1.0) / 1e3
@@ -284,6 +293,11 @@ class Router:
         self.health_poll_s = max(float(health_poll_s), 0.05)
         self._app = appender or JsonlAppender("")
         self._clock = clock
+        # request tracing (docs/OBSERVABILITY.md "Request tracing"):
+        # the router's spans — one per request, one per attempt/hedge
+        # leg — ride its own rank=-1 stream; None/rate-0 = off, and no
+        # tracing branch runs
+        self.tracer = tracer
         self._rr_lock = threading.Lock()
         self._rr = 0
         self._stop = threading.Event()
@@ -446,8 +460,61 @@ class Router:
                 self._inflight -= 1
                 self._inflight_cv.notify_all()
 
+    def _traced_leg(
+        self, ctx: Optional[dict], b: Backend, body: bytes, headers: dict,
+        timeout: float, leg: str,
+    ) -> tuple[bool, int, bytes]:
+        """One forward leg, wrapped in an `attempt` span when the
+        request is traced: the leg's replica/port/outcome land in the
+        span, and the replica sees X-Parent-Span (its server span
+        parents here) plus X-Trace-Force on retry/hedge legs — the
+        replica cannot know the ROUTER's tail verdict, so forced legs
+        tell it to keep its side of the trace."""
+        if ctx is None:
+            return self._try_one(b, body, headers, timeout)
+        tr = ctx["tr"]
+        sp = tr.span(
+            ctx["tid"], "attempt", parent=ctx["root"]["span"],
+            backend=b.idx, port=b.addr[1], leg=leg,
+        )
+        hdrs = {**headers, TRACE_HEADER: ctx["tid"], PARENT_HEADER: sp["span"]}
+        if leg != "primary":
+            hdrs[FORCE_HEADER] = "1"
+        retryable, status, data = self._try_one(b, body, hdrs, timeout)
+        tr.end(sp, status=status, retryable=bool(retryable))
+        return retryable, status, data
+
     def _route(self, body: bytes, headers: dict) -> tuple[int, bytes]:
         self._count("requests")
+        tid = clean_id(headers.get(TRACE_HEADER))
+        tr = self.tracer
+        ctx: Optional[dict] = None
+        if tr is not None and tr.enabled and tid:
+            ctx = {"tr": tr, "tid": tid, "root": tr.span(tid, "request"),
+                   "forced": False}
+        try:
+            status, data = self._route_attempts(body, headers, ctx)
+        finally:
+            if ctx is not None:
+                rec = tr.end(ctx["root"], status=ctx.get("status", 0))
+                # tail verdict: retries/hedges/errors/sheds/slow are
+                # exemplars regardless of the head-sampling decision
+                tr.finish(
+                    tid,
+                    force=ctx["forced"]
+                    or ctx.get("status", 0) >= 500  # incl. 503 sheds
+                    or rec["dur_ms"] / 1e3 > tr.slow_s,
+                )
+        return status, data
+
+    def _route_attempts(
+        self, body: bytes, headers: dict, ctx: Optional[dict]
+    ) -> tuple[int, bytes]:
+        def done(status: int, data: bytes) -> tuple[int, bytes]:
+            if ctx is not None:
+                ctx["status"] = status
+            return status, data
+
         t0 = self._clock()
         deadline = t0 + self.deadline_s
         tried: set = set()
@@ -460,12 +527,14 @@ class Router:
             b = self.pick(exclude=tried)
             if b is None:
                 self._count("no_backend")
-                return 503, json.dumps(
+                return done(503, json.dumps(
                     {"error": "no healthy replica"}
-                ).encode()
+                ).encode())
             tried.add(b.idx)
             if attempt > 0:
                 self._count("retries")
+                if ctx is not None:
+                    ctx["forced"] = True  # a retried request is a tail exemplar
                 if b.idx != prev_idx:
                     # a failover is a retry that actually SWITCHED
                     # replica; pick falls back to the same one when it
@@ -474,12 +543,16 @@ class Router:
             prev_idx = b.idx
             if self.hedge_s > 0 and left > self.hedge_s:
                 retryable, status, data = self._try_hedged(
-                    b, body, headers, left, tried
+                    b, body, headers, left, tried, ctx,
+                    first_leg="retry" if attempt > 0 else "primary",
                 )
             else:
-                retryable, status, data = self._try_one(b, body, headers, left)
+                retryable, status, data = self._traced_leg(
+                    ctx, b, body, headers, left,
+                    "retry" if attempt > 0 else "primary",
+                )
             if not retryable:
-                return status, data
+                return done(status, data)
             last = (status, data)
         # two distinct overload signals with opposite operator fixes:
         # the budget actually expiring (deadline too small / replicas
@@ -490,19 +563,23 @@ class Router:
         else:
             self._count("retries_exhausted")
         if last is not None:
-            return last
-        return 503, json.dumps(
+            return done(*last)
+        return done(503, json.dumps(
             {"error": f"deadline exceeded ({self.deadline_s * 1e3:.0f}ms)"}
-        ).encode()
+        ).encode())
 
     def _try_hedged(
         self, primary: Backend, body: bytes, headers: dict,
-        timeout: float, tried: set,
+        timeout: float, tried: set, ctx: Optional[dict] = None,
+        first_leg: str = "primary",
     ) -> tuple[bool, int, bytes]:
         """Fire at `primary`; after hedge_s with no answer, fire the
         SAME request at one more healthy replica — first non-retryable
         answer wins, a retryable one waits for the other leg. Safe
-        because /predict is idempotent (pure function of the rows)."""
+        because /predict is idempotent (pure function of the rows).
+        Traced legs each get their own attempt span; a losing leg's
+        span lands when its thread finishes — possibly after the
+        request's verdict, the late-span path the tracer keeps."""
         import queue
 
         results: "queue.Queue" = queue.Queue()
@@ -511,11 +588,14 @@ class Router:
         # legs cost the client at most the budget, never 2x it
         t_end = self._clock() + timeout
 
-        def leg(b: Backend, to: float) -> None:
-            results.put((b, self._try_one(b, body, headers, to)))
+        def leg(b: Backend, to: float, name: str = "primary") -> None:
+            results.put((b, self._traced_leg(ctx, b, body, headers, to, name)))
 
+        # a retry entering the hedged path is still a retry leg: the
+        # name puts X-Trace-Force on the wire, so the replica side of
+        # the retried request's trace survives its local head-drop
         threading.Thread(
-            target=leg, args=(primary, timeout), daemon=True
+            target=leg, args=(primary, timeout, first_leg), daemon=True
         ).start()
         legs = 1
         hedged = False
@@ -526,13 +606,15 @@ class Router:
             hedge_b = self.pick(exclude=tried)
             if hedge_b is not None:
                 hedged = True
+                if ctx is not None:
+                    ctx["forced"] = True  # a hedged request is a tail exemplar
                 tried.add(hedge_b.idx)
                 self._count("hedges")
                 self._event(
                     "hedge", backend=primary.idx, hedge_backend=hedge_b.idx
                 )
                 threading.Thread(
-                    target=leg, args=(hedge_b, timeout), daemon=True
+                    target=leg, args=(hedge_b, timeout, "hedge"), daemon=True
                 ).start()
                 legs += 1
         best: Optional[tuple[bool, int, bytes]] = None
@@ -621,10 +703,14 @@ def make_router_http_server(router: Router, host: str, port: int):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
-        def _reply(self, status: int, data: bytes) -> None:
+        def _reply(self, status: int, data: bytes, trace: str = "") -> None:
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if trace:
+                # trace-id echo: whatever id the request carried (or
+                # the router minted) returns with the response
+                self.send_header(TRACE_HEADER, trace)
             self.end_headers()
             self.wfile.write(data)
 
@@ -644,8 +730,16 @@ def make_router_http_server(router: Router, host: str, port: int):
             pr = self.headers.get("X-Request-Priority")
             if pr is not None:
                 fwd["X-Request-Priority"] = pr
+            # trace identity (docs/OBSERVABILITY.md "Request tracing"):
+            # a client-sent X-Trace-Id wins; else the router mints one
+            # when tracing is on — this is the fleet's id birthplace
+            tid = clean_id(self.headers.get(TRACE_HEADER))
+            if not tid and router.tracer is not None and router.tracer.enabled:
+                tid = new_id()
+            if tid:
+                fwd[TRACE_HEADER] = tid
             status, data = router.handle_predict(body, headers=fwd)
-            self._reply(status, data)
+            self._reply(status, data, trace=tid)
 
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":

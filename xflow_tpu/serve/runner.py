@@ -102,6 +102,12 @@ class ServeRunner:
         self._gen: Optional[Generation] = None
         self._gen_counter = 0
         self._reload_lock = threading.Lock()  # one loader at a time
+        # hot-reload spans (docs/OBSERVABILITY.md "Request tracing"):
+        # when serve_main binds a stamped appender here, every reload
+        # swap emits one kind="span" record (start/end + bytes), so
+        # request_trace --timeline can overlay swaps against latency
+        # spikes. None (default) = no span, byte-identical streams.
+        self.span_sink = None
         # compile accounting (train.compile_metrics): the predict
         # program routes through the same CompileRecorder seam the
         # trainer's engines use, so a serving run's stream carries its
@@ -188,6 +194,8 @@ class ServeRunner:
         from xflow_tpu.train import checkpoint as ckpt
 
         with self._reload_lock:
+            is_reload = self._gen is not None
+            t0_wall, t0 = time.time(), time.perf_counter()
             state, step = ckpt.restore_any(
                 self.cfg.train.checkpoint_dir,
                 self._template(),
@@ -209,6 +217,24 @@ class ServeRunner:
             # the swap: one reference assignment — in-flight requests
             # hold the old Generation and finish on the old tables
             self._gen = gen
+            if self.span_sink is not None:
+                # the span covers restore-read through swap — exactly
+                # the window a reload can lengthen request queues in
+                from xflow_tpu.tracing import emit_op_span
+
+                import jax
+
+                emit_op_span(
+                    self.span_sink,
+                    "reload" if is_reload else "serve_load",
+                    t0_wall,
+                    time.perf_counter() - t0,
+                    step=gen.step,
+                    generation=gen.gen,
+                    bytes=int(sum(
+                        x.nbytes for x in jax.tree.leaves(state.tables)
+                    )),
+                )
             return gen
 
     def maybe_reload(self) -> Optional[Generation]:

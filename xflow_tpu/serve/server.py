@@ -43,6 +43,14 @@ from xflow_tpu.serve.coalescer import (
 )
 from xflow_tpu.serve.metrics import ServeMetrics
 from xflow_tpu.serve.runner import BadRequest, CheckpointWatcher, ServeRunner, parse_rows
+from xflow_tpu.tracing import (
+    FORCE_HEADER,
+    PARENT_HEADER,
+    TRACE_HEADER,
+    Tracer,
+    clean_id,
+    new_id,
+)
 
 # request-priority header (docs/SERVING.md "Brownout"): "low" marks a
 # request sheddable under sustained backlog; anything else (or absence)
@@ -66,7 +74,16 @@ class ServeApp:
         self.runner = runner
         scfg = cfg.serve
         self.metrics = metrics or ServeMetrics(
-            scfg.metrics_path, every_s=scfg.metrics_every_s, batch_size=scfg.max_batch
+            scfg.metrics_path, every_s=scfg.metrics_every_s,
+            batch_size=scfg.max_batch, max_bytes=scfg.metrics_max_bytes,
+        )
+        # request tracing (docs/OBSERVABILITY.md "Request tracing"):
+        # spans ride the same stamped serve stream; rate 0 = off, and
+        # the handler/worker paths skip every tracing branch
+        self.tracer = Tracer(
+            self.metrics.appender,
+            sample_rate=scfg.trace_sample_rate,
+            slow_ms=scfg.trace_slow_ms,
         )
 
         def on_brownout(active: bool, queued_rows: int) -> None:
@@ -134,6 +151,7 @@ class ServeApp:
                 continue
             t_done = time.perf_counter()
             device_s = t_done - t_batch
+            self._trace_batch(group, spans, t_batch, t_done, gen)
             queue_waits, totals = [], []
             n_rows = 0
             for req, lo, hi in spans:
@@ -166,12 +184,97 @@ class ServeApp:
 
                 hard_kill()
 
+    # ------------------------------------------------------------- tracing
+    def _trace_batch(self, group, spans, t_batch, t_done, gen) -> None:
+        """Emit the shared device_batch span + each traced member's
+        queue/device spans (the batch-membership link: N request trees
+        reference ONE batch span by id). Zero-cost when tracing is off
+        or no member request carries a trace."""
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        traced = [(req, lo, hi) for req, lo, hi in spans if req.trace]
+        if not traced:
+            return
+        n_rows = sum(hi - lo for _, lo, hi in spans)
+        # flush reason: the oldest member aging past the (possibly
+        # brownout-shrunk) window means a deadline flush; otherwise the
+        # backlog filled the batch (size flush / close drain)
+        oldest_wait = t_batch - min(req.t_submit for req, _, _ in spans)
+        flush = (
+            "window"
+            if oldest_wait >= 0.95 * self.batcher.effective_window_s
+            else "size"
+        )
+        bid = new_id()
+        batch_rec = {
+            "kind": "span",
+            "trace": traced[0][0].trace,
+            "span": bid,
+            "name": "device_batch",
+            "t0": round(tr.wall(t_batch), 6),
+            "dur_ms": round((t_done - t_batch) * 1e3, 3),
+            "requests": len(spans),
+            "rows": n_rows,
+            "batch_fill": round(n_rows / max(self.cfg.serve.max_batch, 1), 4),
+            "flush": flush,
+            "generation": gen.gen,
+        }
+        tr.add_shared(batch_rec, [req.trace for req, _, _ in traced])
+        for req, lo, hi in traced:
+            tr.add(req.trace, {
+                "kind": "span", "trace": req.trace, "span": new_id(),
+                "parent": req.span, "name": "queue",
+                "t0": round(tr.wall(req.t_submit), 6),
+                "dur_ms": round((t_batch - req.t_submit) * 1e3, 3),
+                "rows": hi - lo,
+            })
+            tr.add(req.trace, {
+                "kind": "span", "trace": req.trace, "span": new_id(),
+                "parent": req.span, "name": "device",
+                "t0": round(tr.wall(t_batch), 6),
+                "dur_ms": round((t_done - t_batch) * 1e3, 3),
+                "batch": bid,
+            })
+
     # ----------------------------------------------------------- app logic
-    def handle_predict(self, body: bytes, priority: int = 0) -> tuple[int, dict]:
+    def handle_predict(
+        self,
+        body: bytes,
+        priority: int = 0,
+        trace_id: str = "",
+        parent_span: str = "",
+        force_trace: bool = False,
+    ) -> tuple[int, dict]:
         """(http_status, response dict) for one POST /predict body:
         {"rows": ["field:feat field:feat ...", ...]}. `priority` < 0
         (the X-Request-Priority: low header) marks the request
-        sheddable under brownout."""
+        sheddable under brownout. `trace_id`/`parent_span`/`force_trace`
+        carry the X-Trace-Id / X-Parent-Span / X-Trace-Force headers:
+        with tracing on, the request's server/parse/queue/device spans
+        buffer under the trace and flush on its verdict (head-sampled,
+        router-forced, or tail-captured here: error/shed/slow)."""
+        tr = self.tracer if (self.tracer.enabled and trace_id) else None
+        if tr is None:
+            return self._predict_impl(body, priority)
+        root = tr.span(trace_id, "server", parent=parent_span or None)
+        status, payload = self._predict_impl(
+            body, priority, tr=tr, trace_id=trace_id, root=root
+        )
+        rec = tr.end(root, status=status)
+        # tail capture: errors, sheds, and slow requests are exemplars
+        # whatever the sampling verdict (docs/OBSERVABILITY.md)
+        tr.finish(
+            trace_id,
+            force=force_trace or status != 200
+            or rec["dur_ms"] / 1e3 > tr.slow_s,
+        )
+        return status, payload
+
+    def _predict_impl(
+        self, body: bytes, priority: int = 0, tr=None, trace_id: str = "",
+        root=None,
+    ) -> tuple[int, dict]:
         try:
             payload = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -181,13 +284,26 @@ class ServeApp:
         if not isinstance(rows, list) or not rows:
             self.metrics.observe_bad_request()
             return 400, {"error": 'expected {"rows": [<libffm feature row>, ...]}'}
+        t_parse = time.perf_counter()
         try:
             fields_rows, slots_rows = parse_rows(rows, self.cfg.data)
         except BadRequest as e:
             self.metrics.observe_bad_request()
             return 400, {"error": str(e)}
+        if tr is not None:
+            tr.add(trace_id, {
+                "kind": "span", "trace": trace_id, "span": new_id(),
+                "parent": root["span"], "name": "parse",
+                "t0": round(tr.wall(t_parse), 6),
+                "dur_ms": round((time.perf_counter() - t_parse) * 1e3, 3),
+                "rows": len(rows),
+            })
         try:
-            fut = self.batcher.submit(fields_rows, slots_rows, priority=priority)
+            fut = self.batcher.submit(
+                fields_rows, slots_rows, priority=priority,
+                trace=trace_id if tr is not None else "",
+                span=root["span"] if tr is not None else "",
+            )
         except RejectedRequest as e:
             if e.shed:
                 # brownout shed is ADMISSION telemetry, not a bad
@@ -240,11 +356,15 @@ def _make_handler(app: ServeApp):
         # connect-per-request
         protocol_version = "HTTP/1.1"
 
-        def _reply(self, status: int, payload: dict) -> None:
+        def _reply(self, status: int, payload: dict, trace: str = "") -> None:
             data = json.dumps(payload).encode("utf-8")
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            if trace:
+                # the trace-id echo: every response returns the id the
+                # request carried (serve_bench asserts the round trip)
+                self.send_header(TRACE_HEADER, trace)
             self.end_headers()
             self.wfile.write(data)
 
@@ -257,10 +377,21 @@ def _make_handler(app: ServeApp):
             except ValueError:
                 n = 0
             body = self.rfile.read(n) if n > 0 else b""
+            # trace identity: a client-sent X-Trace-Id wins; with
+            # tracing on, a direct (router-less) client gets one minted
+            # here — the id is echoed either way, sampled only when
+            # tracing is on
+            tid = clean_id(self.headers.get(TRACE_HEADER))
+            if not tid and app.tracer.enabled:
+                tid = new_id()
             status, payload = app.handle_predict(
-                body, priority=parse_priority(self.headers.get(PRIORITY_HEADER))
+                body,
+                priority=parse_priority(self.headers.get(PRIORITY_HEADER)),
+                trace_id=tid,
+                parent_span=clean_id(self.headers.get(PARENT_HEADER)),
+                force_trace=self.headers.get(FORCE_HEADER) == "1",
             )
-            self._reply(status, payload)
+            self._reply(status, payload, trace=tid)
 
         def do_GET(self):  # noqa: N802
             if self.path == "/healthz":
@@ -354,6 +485,11 @@ def serve_main(cfg: Config, mesh=None, ready_out=None) -> int:
         # compile records join the serve stream (the predict program
         # compiles lazily on the first batch, after this bind)
         runner.compile_recorder.bind(app.metrics.appender)
+    if app.tracer.enabled:
+        # hot-reload swaps emit kind="span" records into the same
+        # stream (request_trace --timeline overlays them); off when
+        # tracing is off so rate-0 streams stay byte-identical
+        runner.span_sink = app.metrics.appender
     app.metrics.event("start", generation=gen.gen, step=gen.step)
     try:
         # the fleet's staggered-reload offset (serve/fleet.py exports

@@ -1,0 +1,259 @@
+"""Request-path distributed tracing (docs/OBSERVABILITY.md "Request
+tracing").
+
+The serving fleet answers one request through many independent hops —
+client -> router (retry/hedge legs, breaker consults) -> replica
+coalescer (queue wait, brownout window) -> one shared device batch ->
+response — and the aggregate counters (PR 7/8: p99 windows, failovers,
+shed_requests) cannot say WHICH hop ate a slow request's budget. This
+module is the Dapper-style span layer that can: every hop appends a
+`kind="span"` JSONL record through the existing stamped appender
+(replica/port/gen/world stamps free), keyed by one trace id that
+travels the whole path in the `X-Trace-Id` header and is echoed back
+to the client. tools/request_trace.py reassembles the per-replica +
+router streams into per-request timelines and critical paths.
+
+Three design points carry the module:
+
+- **Deterministic head sampling.** `sampled(trace_id, rate)` hashes
+  the trace id itself, so the router and every replica make the SAME
+  keep/drop decision with zero coordination — a kept trace is kept at
+  every hop it touched, never a torso. `serve.trace_sample_rate=0`
+  disables tracing outright (the serve streams stay byte-identical to
+  pre-tracing builds).
+
+- **Tail-based capture.** Exactly the requests you page on — errors,
+  sheds, retries, hedges, anything over `serve.trace_slow_ms` — are
+  ALWAYS captured: spans buffer per trace in the process that made
+  them and flush on the request's completion verdict (`finish(force=)`),
+  so head sampling bounds the steady-state cost while the tail
+  exemplars are guaranteed on disk. A hop that cannot know the verdict
+  locally is told: the router stamps `X-Trace-Force: 1` onto retry and
+  hedge legs so the replica side of a forced trace survives too.
+
+- **Shared batch spans.** The coalescer answers N requests with ONE
+  device batch; that batch is one `device_batch` span (batch_fill,
+  flush reason, device time) added to every member trace and emitted
+  exactly once when the first sampled member flushes — request spans
+  link to it by span id (`batch=`), turning "my request was slow" into
+  "my request rode a 3%-full window flush behind a 2.1 ms device
+  batch".
+
+Span record shape (the appender prefixes ts/rank/run_id/gen/world and,
+in a fleet, replica/port):
+
+    {"kind": "span", "trace": <16-hex>, "span": <16-hex>,
+     "parent": <span id, absent on the root>, "name": "request" |
+     "attempt" | "server" | "parse" | "queue" | "device" |
+     "device_batch" | "reload" | "checkpoint_save" | ...,
+     "t0": <wall seconds>, "dur_ms": <float>, ...attrs}
+
+Durations are perf_counter-measured; `t0` converts to wall-clock
+through one per-process offset so spans from different processes on
+one host line up (the same correlation-only contract as the `ts`
+stamp, xflow_tpu/jsonl.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Iterable, Optional
+
+# the propagation headers (serve/server.py, serve/router.py,
+# tools/serve_bench.py speak them; any HTTP proxy can forward them)
+TRACE_HEADER = "X-Trace-Id"
+PARENT_HEADER = "X-Parent-Span"
+FORCE_HEADER = "X-Trace-Force"
+
+# request-path span names (tools/request_trace.py and the
+# metrics_report --check span gates key on these; operational spans —
+# reload / checkpoint_save / checkpoint_restore — are everything else)
+REQUEST_SPAN_NAMES = ("request", "attempt", "server", "parse", "queue", "device")
+BATCH_SPAN_NAME = "device_batch"
+
+
+def new_id() -> str:
+    """A fresh 16-hex trace/span id."""
+    return uuid.uuid4().hex[:16]
+
+
+def clean_id(value: Optional[str]) -> str:
+    """A header-supplied id, sanitized: stripped, length-capped, token
+    characters only ('' = unusable). Ids land verbatim in JSONL and in
+    echoed headers — an adversarial header must not inject either."""
+    if not value:
+        return ""
+    value = value.strip()
+    if not value or len(value) > 64:
+        return ""
+    if not all(c.isalnum() or c in "-_." for c in value):
+        return ""
+    return value
+
+
+def sampled(trace_id: str, rate: float) -> bool:
+    """The head-sampling decision for one trace id — a pure function of
+    the id, so every hop (router, each replica) agrees without
+    coordination. rate <= 0 never samples, >= 1 always does."""
+    if rate <= 0:
+        return False
+    if rate >= 1:
+        return True
+    h = int(hashlib.sha1(trace_id.encode("utf-8", "replace")).hexdigest()[:8], 16)
+    return h / float(1 << 32) < rate
+
+
+class Tracer:
+    """Per-process span buffer + sampling verdicts over one stamped
+    JSONL appender. Thread-safe: HTTP handler threads, the device
+    worker, and the router's hedge legs all add spans concurrently.
+
+    Lifecycle per trace: `span()`/`end()` (or `add()`) buffer records
+    under the trace id; `finish(trace, force=)` delivers the verdict —
+    emit everything (head-sampled or forced) or drop everything. A span
+    landing AFTER the verdict (a hedge leg losing the race) follows the
+    recorded verdict, so a kept trace never loses its stragglers.
+    Verdict memory and the pending buffer are both bounded: a trace
+    whose finish never comes (a leaked id) is evicted oldest-first
+    instead of growing the process."""
+
+    def __init__(
+        self,
+        appender,
+        sample_rate: float = 0.0,
+        slow_ms: float = 250.0,
+        max_pending: int = 2048,
+        max_verdicts: int = 8192,
+    ):
+        self._app = appender
+        self.sample_rate = float(sample_rate)
+        self.slow_s = max(float(slow_ms), 0.0) / 1e3
+        self._max_pending = max(int(max_pending), 1)
+        self._max_verdicts = max(int(max_verdicts), 1)
+        self._lock = threading.Lock()
+        self._pending: "OrderedDict[str, list]" = OrderedDict()
+        self._verdicts: "OrderedDict[str, bool]" = OrderedDict()
+        # one per-process perf->wall offset: every span of a process
+        # converts through the same anchor, so intra-process deltas
+        # stay perf-counter-exact
+        self._wall_off = time.time() - time.perf_counter()
+
+    @property
+    def enabled(self) -> bool:
+        """Tracing is on iff the sample rate is positive — rate 0 is
+        the byte-identical-output switch, tail capture included."""
+        return self.sample_rate > 0
+
+    def wall(self, t_perf: float) -> float:
+        return t_perf + self._wall_off
+
+    # -------------------------------------------------------------- spans
+    def span(self, trace: str, name: str, parent: Optional[str] = None,
+             t0: Optional[float] = None, **attrs) -> dict:
+        """An OPEN span handle: its id exists now (children/headers can
+        reference it) but nothing is buffered until `end()`. `t0` is a
+        perf_counter instant (default: now)."""
+        s = {
+            "trace": trace,
+            "span": new_id(),
+            "name": name,
+            "_t0": time.perf_counter() if t0 is None else float(t0),
+        }
+        if parent:
+            s["parent"] = parent
+        s.update(attrs)
+        return s
+
+    def end(self, span: dict, t1: Optional[float] = None, **attrs) -> dict:
+        """Close an open span and buffer its record; returns the
+        record (tests)."""
+        t1 = time.perf_counter() if t1 is None else float(t1)
+        t0 = span.pop("_t0")
+        rec = {
+            "kind": "span",
+            **span,
+            **attrs,
+            "t0": round(self.wall(t0), 6),
+            "dur_ms": round(max(t1 - t0, 0.0) * 1e3, 3),
+        }
+        self.add(rec["trace"], rec)
+        return rec
+
+    def add(self, trace: str, rec: dict) -> None:
+        """Buffer one finished span record under its trace (or follow
+        an already-recorded verdict — the late-span path)."""
+        with self._lock:
+            verdict = self._verdicts.get(trace)
+            if verdict is None:
+                self._pending.setdefault(trace, []).append(rec)
+                while len(self._pending) > self._max_pending:
+                    self._pending.popitem(last=False)  # evict oldest
+                return
+            emit = verdict
+        if emit:
+            self._emit(rec)
+
+    def add_shared(self, rec: dict, traces: Iterable[str]) -> None:
+        """Buffer ONE record (a device-batch span) under several
+        traces; whichever member trace emits first carries it, the
+        rest see it already done — the span appends exactly once."""
+        rec["_shared"] = False  # not yet emitted
+        for t in traces:
+            self.add(t, rec)
+
+    def _emit(self, rec: dict) -> None:
+        # shared records emit once, whichever sampled member flushes
+        # first (checked under the appender's own lock-free path is
+        # fine: _shared flips under OUR lock in finish/add)
+        if "_shared" in rec:
+            with self._lock:
+                if rec["_shared"]:
+                    return
+                rec["_shared"] = True
+            rec = {k: v for k, v in rec.items() if k != "_shared"}
+        self._app.append(rec)
+
+    # ------------------------------------------------------------ verdicts
+    def finish(self, trace: str, force: bool = False) -> bool:
+        """Deliver the trace's verdict: emit its buffered spans when
+        head-sampled or `force`d (tail capture), else drop them.
+        Returns whether the trace was emitted."""
+        emit = force or sampled(trace, self.sample_rate)
+        with self._lock:
+            spans = self._pending.pop(trace, [])
+            self._verdicts[trace] = emit
+            while len(self._verdicts) > self._max_verdicts:
+                self._verdicts.popitem(last=False)
+        if emit:
+            for rec in spans:
+                self._emit(rec)
+        return emit
+
+    def pending_traces(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+def emit_op_span(appender, name: str, t0_wall: float, dur_s: float,
+                 **attrs) -> dict:
+    """One standalone OPERATIONAL span — checkpoint save/restore, a
+    serve hot-reload swap — always emitted (these are rare, operator-
+    initiated events, not per-request traffic; sampling them would
+    punch holes in the exact timeline request_trace --timeline overlays
+    against latency spikes). Each gets its own fresh trace id so the
+    request-trace parenting gates never see it as a torso."""
+    rec = {
+        "kind": "span",
+        "trace": new_id(),
+        "span": new_id(),
+        "name": name,
+        "t0": round(t0_wall, 6),
+        "dur_ms": round(max(dur_s, 0.0) * 1e3, 3),
+        **attrs,
+    }
+    appender.append(rec)
+    return rec

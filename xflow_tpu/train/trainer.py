@@ -114,6 +114,7 @@ class Trainer:
         self.metrics = MetricsLogger(
             cfg.train.metrics_path,
             stamp={"rank": self.rank, "run_id": self.run_id},
+            max_bytes=cfg.train.metrics_max_bytes,
         )
         # compile accounting (train.compile_metrics, docs/OBSERVABILITY.md
         # "Compile accounting"): explicit timed .lower().compile() per
@@ -1586,9 +1587,33 @@ class Trainer:
             )
         return epoch, skips
 
+    def _ckpt_span(self, name: str, t0_wall: float, t0: float,
+                   step: int) -> None:
+        """One kind="span" record per checkpoint save/restore
+        (train.ckpt_spans): the checkpoint lifecycle joins the same
+        span stream serving emits, so tools/request_trace.py --timeline
+        can overlay saves/reloads against request-latency spikes."""
+        if not self.cfg.train.ckpt_spans or not self.metrics.enabled:
+            # enabled guards the tree walk + nbytes sum: with no
+            # metrics sink the record would be built only to no-op
+            return
+        from xflow_tpu.tracing import emit_op_span
+
+        emit_op_span(
+            self.metrics, name, t0_wall, time.perf_counter() - t0,
+            step=int(step),
+            bytes=int(sum(
+                x.nbytes
+                for x in jax.tree.leaves(
+                    (self.state.tables, self.state.opt_state)
+                )
+            )),
+        )
+
     def save_checkpoint(self) -> None:
         from xflow_tpu.train import checkpoint as ckpt
 
+        t0_wall, t0 = time.time(), time.perf_counter()
         data_state = self._data_state_record()
         if self.cfg.train.checkpoint_format == "orbax":
             # orbax stores the device arrays in their NATIVE (possibly
@@ -1605,6 +1630,7 @@ class Trainer:
                 self._logical_widths(),
                 data_state=data_state,
             )
+        self._ckpt_span("checkpoint_save", t0_wall, t0, int(self.state.step))
         # retention + stale-uncommitted sweep AFTER the commit: the save
         # that just landed proves no writer owns the swept debris
         ckpt.prune_checkpoints(
@@ -1646,6 +1672,7 @@ class Trainer:
         # CURRENT state's sharding, whatever world size/engine wrote
         # the checkpoint. No checkpoint at all = fresh start; raises
         # only when checkpoints exist and NONE loads.
+        t0_wall, t0 = time.time(), time.perf_counter()
         try:
             self.state, step = ckpt.restore_any(
                 cdir, self.state, fmt=fmt,
@@ -1653,6 +1680,7 @@ class Trainer:
             )
         except FileNotFoundError:
             return False
+        self._ckpt_span("checkpoint_restore", t0_wall, t0, int(step))
         # the data-stream position travels with the step that actually
         # restored (a walk-back must not pair step N-1's weights with
         # step N's stream offset); missing/unreadable data_state
