@@ -1,0 +1,22 @@
+"""XF401 fixture: misspelled config keys (never executed)."""
+
+from xflow_tpu.config import Config, ServeConfig, override
+
+
+def misspelled_attr(cfg: Config):
+    return cfg.train.lag_every  # XF401: train.log_every typo
+
+
+def misspelled_section(cfg: Config):
+    return cfg.sreve.port  # XF401: serve typo
+
+
+def misspelled_in_subtree(scfg: ServeConfig):
+    return scfg.windw_ms  # XF401: serve.window_ms typo
+
+
+def misspelled_override(cfg: Config):
+    return override(cfg, **{"train.epocs": 3})  # XF401: train.epochs typo
+
+
+CLI_ARGS = ["--set", "serve.max_bach=128"]  # XF401: serve.max_batch typo

@@ -1,0 +1,41 @@
+"""XF101 fixture: host effects inside traced code (never executed)."""
+
+import random
+import time
+
+import jax
+import jax.lax as lax
+import numpy as np
+
+COUNT = 0
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()  # XF101: host timer freezes at trace time
+    print("stepping", x)  # XF101: prints once per compile
+    return x * random.random() + t0  # XF101: host RNG
+
+
+def scan_body(carry, x):
+    np.random.seed(0)  # XF101: reached via lax.scan body
+    return carry + x, x
+
+
+def outer(xs):
+    return lax.scan(scan_body, 0.0, xs)
+
+
+def impure_helper():
+    global COUNT  # XF101: global mutation, reached from a jit root
+    COUNT += 1
+
+
+@jax.jit
+def uses_helper(x):
+    impure_helper()
+    return x
+
+
+def traced_lambda(xs):
+    return jax.jit(lambda v: v + time.time())(xs)  # XF101: lambda body

@@ -1,0 +1,64 @@
+"""XF301 fixture: the pre-PR 8 unlocked JsonlAppender, reproduced in
+its first multi-threaded caller (never executed).
+
+Before PR 8, `xflow_tpu/jsonl.py JsonlAppender.append` had no lock —
+written for the single-threaded trainer. The serving-fleet router then
+called one appender from request-handler threads AND its health loop
+at once, and two `write()` calls could interleave two records into one
+damaged JSONL line. This file is the pre-fix `append`/`close` bodies
+(lazy open, stamp fold, write+flush — no `self._lock`) inside a
+router-shaped class that spawns the health thread; the lockset pass
+must flag the `_f`/`_size`/`_static` mutations forever.
+"""
+
+import json
+import os
+import threading
+import time
+
+
+class UnlockedFleetAppender:
+    """Pre-PR 8 appender + the PR 8 caller shape that broke it."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f = None
+        self._size = 0
+        self._static = None
+        self._stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True
+        )
+        self._health_thread.start()
+
+    # ---- the health loop: one writer thread -------------------------
+    def _health_loop(self):
+        while not self._stop.wait(0.5):
+            self.append({"kind": "serve", "event": "health"})
+
+    # ---- the request handlers: N more writer threads ----------------
+    def handle_request(self, record: dict):
+        self.append({"kind": "serve", **record})
+
+    # ---- the PRE-FIX append: no lock anywhere -----------------------
+    def append(self, record: dict):
+        if not self._path:
+            return
+        if self._f is None:
+            parent = os.path.dirname(self._path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f = open(self._path, "a")  # unlocked lazy open
+        if self._static is None:
+            self._static = {"rank": 0, "run_id": "fixture"}
+        rec = {"ts": round(time.time(), 6), **self._static, **record}
+        line = json.dumps(rec) + "\n"
+        self._f.write(line)  # two threads here = one damaged line
+        self._f.flush()
+        self._size += len(line)
+
+    def close(self):
+        self._stop.set()
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -1,0 +1,34 @@
+"""XF201/XF202/XF203 fixture: jit-cache thrash patterns (never run)."""
+
+import jax
+
+
+def f(x, n):
+    return x * n
+
+
+def jit_in_loop(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(f)(x, 2))  # XF201: fresh callable per iteration
+    return out
+
+
+g = jax.jit(f, static_argnums=(1,))
+
+
+def unhashable_static(x):
+    return g(x, [1, 2])  # XF203: list literal in a static slot
+
+
+def varying_static(x):
+    a = g(x, 3)  # XF202: 3 vs 4 below — one compile per value
+    b = g(x, 4)
+    return a + b
+
+
+def loop_var_static(x):
+    total = x
+    for k in range(8):
+        total = g(total, k)  # XF202: loop variable in a static slot
+    return total

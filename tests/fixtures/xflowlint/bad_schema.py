@@ -1,0 +1,27 @@
+"""XF501/XF502 fixture: records drifting from docs/OBSERVABILITY.md
+(never executed)."""
+
+from xflow_tpu.jsonl import JsonlAppender
+
+
+def drifted_window(app):
+    app.append({
+        "kind": "serve",
+        "qps": 10.0,
+        "queue_wait_p50ms": 1.2,  # XF501: drifted (queue_wait_p50_ms)
+    })
+
+
+def undocumented_kind(app):
+    app.append({"kind": "shadow", "x": 1})  # XF502: no schema section
+
+
+class StampedSink:
+    def __init__(self, path):
+        self.beats = JsonlAppender(
+            path, stamp={"rank": 0, "run_id": "r", "kind": "heartbeat"}
+        )
+
+    def beat(self, step):
+        # XF501: heartbeat schema has `step`/`event`, not `stepp`
+        self.beats.append({"stepp": step})

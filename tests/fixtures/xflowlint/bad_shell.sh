@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# XF601 + XF401 fixture: no pipefail, and a misspelled --set key.
+set -eu
+
+python -m xflow_tpu train --set train.log_evry=10  # XF401: log_every typo

@@ -1,0 +1,56 @@
+"""Negative fixture: idiomatic patterns near every rule's boundary —
+must produce zero findings (never executed)."""
+
+import threading
+import time
+
+import jax
+
+from xflow_tpu.config import Config
+
+
+@jax.jit
+def pure_step(x):
+    # jax.debug.print is the sanctioned escape hatch
+    jax.debug.print("x = {}", x)
+    return x * 2
+
+
+def host_timing(xs):
+    # timers OUTSIDE the traced function are the PR 2 idiom
+    t0 = time.perf_counter()
+    y = pure_step(xs)
+    return y, time.perf_counter() - t0
+
+
+def valid_config_reads(cfg: Config):
+    return cfg.train.log_every, cfg.serve.window_ms, cfg.num_slots
+
+
+class SingleThreadedCounter:
+    """No thread spawn -> the lockset pass must not analyze it."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1  # single-threaded mutation is fine
+
+
+class LockedWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+
+
+def documented_record(app):
+    app.append({"kind": "serve", "event": "start"})

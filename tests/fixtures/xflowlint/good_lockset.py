@@ -1,0 +1,45 @@
+"""XF301 negative fixture: the POST-PR 8 shape — same threads, same
+mutations, every write under the append lock. Must stay silent."""
+
+import json
+import threading
+import time
+
+
+class LockedFleetAppender:
+    def __init__(self, path: str):
+        self._path = path
+        self._f = None
+        self._size = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True
+        )
+        self._health_thread.start()
+
+    def _health_loop(self):
+        while not self._stop.wait(0.5):
+            self.append({"kind": "serve", "event": "health"})
+
+    def handle_request(self, record: dict):
+        self.append({"kind": "serve", **record})
+
+    def append(self, record: dict):
+        if not self._path:
+            return
+        with self._lock:
+            if self._f is None:
+                self._f = open(self._path, "a")
+            rec = {"ts": round(time.time(), 6), **record}
+            line = json.dumps(rec) + "\n"
+            self._f.write(line)
+            self._f.flush()
+            self._size += len(line)
+
+    def close(self):
+        self._stop.set()
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None

@@ -1,0 +1,12 @@
+"""Suppression fixture: the same XF101 violations as
+bad_jit_purity.py, silenced inline — must produce zero findings."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def timed(x):
+    t0 = time.perf_counter()  # xflowlint: disable=XF101 — fixture: intentional
+    return x + t0

@@ -1,0 +1,496 @@
+"""xflowlint (xflow_tpu/analysis, tools/xflowlint.py,
+tools/smoke_lint.sh): the fixture corpus proves every rule fires on
+known-bad code — including the resurrected pre-PR 8 unlocked-appender
+bug — and stays silent on the fixed shapes; suppression, baseline, and
+CLI exit-code semantics are pinned; seeding a violation of each rule
+class into a scratch copy of a REAL module is caught with the correct
+rule id and file:line (the ISSUE 10 acceptance drill)."""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from xflow_tpu.analysis.core import (  # noqa: E402
+    Baseline, BaselineEntry, Finding, Module, Project, run_passes,
+)
+
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "xflowlint")
+
+
+def lint(*paths, root=REPO_ROOT, rules=None):
+    project = Project.load(root, [os.path.join(FIXTURES, p) if not
+                                  os.path.isabs(p) else p for p in paths])
+    only = set(rules) if rules else None
+    return run_passes(project, only_rules=only)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def marker_lines(fixture, rule):
+    """Lines in a fixture carrying a `# XFnnn:` expectation marker."""
+    out = set()
+    with open(os.path.join(FIXTURES, fixture)) as f:
+        for i, line in enumerate(f, 1):
+            if f"# {rule}:" in line:
+                out.add(i)
+    return out
+
+
+# ------------------------------------------------------------ rule firing
+
+
+def test_jit_purity_fixture_fires_on_every_marker():
+    findings = lint("bad_jit_purity.py")
+    assert rules_of(findings) == ["XF101"]
+    assert {f.line for f in findings} == marker_lines(
+        "bad_jit_purity.py", "XF101")
+    # the PR 2 rule by name: perf_counter inside a jit body
+    assert any("time.perf_counter" in f.message for f in findings)
+    # RNG, print, global, scan-body, and traced-lambda variants all land
+    blob = " ".join(f.message for f in findings)
+    for needle in ("random.random", "print", "global mutation",
+                   "numpy.random.seed", "time.time"):
+        assert needle in blob, needle
+
+
+def test_recompile_fixture_fires_all_three_rules():
+    findings = lint("bad_recompile.py")
+    by_rule = {r: [f for f in findings if f.rule == r]
+               for r in rules_of(findings)}
+    assert set(by_rule) == {"XF201", "XF202", "XF203"}
+    assert {f.line for f in by_rule["XF201"]} == marker_lines(
+        "bad_recompile.py", "XF201")
+    assert {f.line for f in by_rule["XF202"]} == marker_lines(
+        "bad_recompile.py", "XF202")
+    assert {f.line for f in by_rule["XF203"]} == marker_lines(
+        "bad_recompile.py", "XF203")
+
+
+def test_lockset_fixture_retro_detects_pre_pr8_appender():
+    """The resurrected pre-PR 8 JsonlAppender (no append lock, health
+    thread + handler threads) must fire on every unlocked mutation of
+    the shared file-handle state."""
+    findings = lint("bad_lockset.py")
+    assert rules_of(findings) == ["XF301"]
+    attrs = {re.search(r"`self\.(\w+)`", f.message).group(1)
+             for f in findings}
+    # the lazy-open handle and its byte counter are the bug
+    assert "_f" in attrs and "_size" in attrs
+    # every finding names both regions that collide
+    for f in findings:
+        assert "thread:_health_loop" in f.message
+        assert "external" in f.message
+
+
+def test_lockset_silent_on_fixed_appender():
+    assert lint("good_lockset.py") == []
+
+
+def test_config_fixture_fires_on_every_marker():
+    findings = lint("bad_config.py")
+    assert rules_of(findings) == ["XF401"]
+    assert {f.line for f in findings} == marker_lines(
+        "bad_config.py", "XF401")
+    blob = " ".join(f.message for f in findings)
+    for needle in ("train.lag_every", "sreve", "windw_ms", "train.epocs",
+                   "serve.max_bach"):
+        assert needle in blob, needle
+
+
+def test_schema_fixture_fires_drift_and_unknown_kind():
+    findings = lint("bad_schema.py")
+    assert rules_of(findings) == ["XF501", "XF502"]
+    msgs = " ".join(f.message for f in findings)
+    assert "queue_wait_p50ms" in msgs  # drifted serve window key
+    assert "stepp" in msgs  # drift against a stamp-declared kind
+    assert '"shadow"' in msgs  # unknown kind
+
+
+def test_shell_fixture_fires_strict_mode_and_bad_key():
+    findings = lint("bad_shell.sh")
+    assert rules_of(findings) == ["XF401", "XF601"]
+    (f601,) = [f for f in findings if f.rule == "XF601"]
+    assert "-o pipefail" in f601.message
+    (f401,) = [f for f in findings if f.rule == "XF401"]
+    assert "train.log_evry" in f401.message
+
+
+def test_unrecorded_jit_fires_only_in_recorder_scoped_paths(tmp_path):
+    """XF204 is scoped to the engine/serve modules where PR 7's
+    CompileRecorder contract holds."""
+    src = (
+        "import jax\n"
+        "def build(model):\n"
+        "    def step(s, b):\n"
+        "        return s\n"
+        "    return jax.jit(step)\n"
+    )
+    scoped = tmp_path / "xflow_tpu" / "serve"
+    scoped.mkdir(parents=True)
+    (scoped / "newmod.py").write_text(src)
+    unscoped = tmp_path / "xflow_tpu" / "data"
+    unscoped.mkdir(parents=True)
+    (unscoped / "newmod.py").write_text(src)
+    findings = lint(str(scoped / "newmod.py"),
+                    str(unscoped / "newmod.py"), root=str(tmp_path))
+    assert rules_of(findings) == ["XF204"]
+    assert [f.path for f in findings] == ["xflow_tpu/serve/newmod.py"]
+    assert findings[0].line == 5
+
+
+# ------------------------------------------------- precision (no false fire)
+
+
+def test_loop_var_static_check_is_scope_local(tmp_path):
+    """A parameter named like an unrelated loop variable in another
+    function is NOT a loop variable (XF202 stays quiet)."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\ndef f(x, n):\n    return x * n\n\n\n"
+        "def other(xs):\n    for k in xs:\n        print(k)\n\n\n"
+        "g = jax.jit(f, static_argnums=(1,))\n\n\n"
+        "def call(k):\n    return g(1.0, k)\n"
+    )
+    assert lint(str(mod), rules=["XF202"]) == []
+
+
+def test_lockset_private_thread_only_helper_not_external(tmp_path):
+    """A private helper only the spawned thread calls is single-
+    threaded — no finding; the same helper called from a PUBLIC method
+    still fires."""
+    base = (
+        "import threading\n\n\nclass W:\n"
+        "    def __init__(self):\n"
+        "        self._buf = []\n"
+        "        threading.Thread(target=self._run, daemon=True).start()\n\n"
+        "    def _run(self):\n        self._flush()\n\n"
+        "    def _flush(self):\n        self._buf = []\n"
+    )
+    mod = tmp_path / "w.py"
+    mod.write_text(base)
+    assert lint(str(mod), rules=["XF301"]) == []
+    mod.write_text(base + "\n    def drain(self):\n        self._flush()\n")
+    assert [f.rule for f in lint(str(mod), rules=["XF301"])] == ["XF301"]
+
+
+def test_shell_strict_mode_must_precede_commands(tmp_path):
+    """`set -euo pipefail` AFTER fallible commands protects nothing."""
+    sh = tmp_path / "late.sh"
+    sh.write_text("#!/usr/bin/env bash\nrm -rf \"$1\"\nset -euo pipefail\n")
+    assert [f.rule for f in lint(str(sh))] == ["XF601"]
+
+
+def test_shell_comment_mentions_of_keys_ignored(tmp_path):
+    sh = tmp_path / "c.sh"
+    sh.write_text("#!/usr/bin/env bash\nset -euo pipefail\n"
+                  "# historical note: serve.windw_ms=3 was renamed\n"
+                  "true\n")
+    assert lint(str(sh)) == []
+
+
+# ------------------------------------------------- suppression / negatives
+
+
+def test_inline_and_file_suppressions():
+    assert lint("suppress_line.py") == []
+    assert lint("suppress_file.py") == []
+    # the same code without the directive DOES fire (the suppression is
+    # what silences it, not a pass gap)
+    mod = Module("x.py", "x.py",
+                 open(os.path.join(FIXTURES, "suppress_line.py")).read()
+                 .replace("# xflowlint: disable=XF101", ""))
+    assert not mod.line_suppress
+
+
+def test_clean_fixture_is_clean():
+    assert lint("good_clean.py") == []
+
+
+# -------------------------------------------------------- baseline model
+
+
+def _finding(rule="XF101", path="a.py", line=3, message="m"):
+    return Finding(rule=rule, path=path, line=line, message=message)
+
+
+def test_baseline_split_new_known_stale():
+    base = Baseline([BaselineEntry("XF101", "a.py", "m", reason="legacy")])
+    new, known, stale = base.split([_finding(), _finding(line=9)])
+    # line numbers are NOT part of the fingerprint: both match
+    assert not new and len(known) == 2 and not stale
+    new, known, stale = base.split([_finding(message="other")])
+    assert len(new) == 1 and not known and len(stale) == 1
+
+
+def test_baseline_staleness_scoped_to_selected_rules():
+    """`--rules XF301` skips the config pass — an XF401 baseline entry
+    must not read as stale just because its pass never ran."""
+    base = Baseline([BaselineEntry("XF401", "a.py", "m", reason="legacy")])
+    _new, _known, stale = base.split([], only_rules={"XF301"})
+    assert stale == []
+    _new, _known, stale = base.split([], only_rules={"XF401"})
+    assert len(stale) == 1
+    _new, _known, stale = base.split([])  # full run: stale for real
+    assert len(stale) == 1
+
+
+def test_syntax_error_respects_rules_filter_and_suppression(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings = lint(str(bad))
+    assert rules_of(findings) == ["XF001"]
+    # --rules excluding XF001 filters it
+    assert lint(str(bad), rules=["XF301"]) == []
+    # disable-file works even though the file never parsed
+    bad.write_text("# xflowlint: disable-file=XF001 — generated junk\n"
+                   "def f(:\n")
+    assert lint(str(bad)) == []
+
+
+def test_shell_all_wildcard_suppression(tmp_path):
+    from xflow_tpu.analysis.core import ShellScript
+
+    sh = ShellScript("x.sh", "x.sh",
+                     "# xflowlint: disable-file=all\necho hi\n")
+    assert sh.suppressed("XF601", 2)  # Module and ShellScript agree
+
+
+def test_write_baseline_refuses_partial_scan_and_keeps_reasons(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_jit_purity.py")
+    # partial path set + no explicit --baseline: refuse (3), never
+    # clobber the repo-wide baseline with a partial scan
+    r = run_cli(bad, "--write-baseline")
+    assert r.returncode == 3 and "PARTIAL" in r.stderr
+    # an audited reason survives regeneration of the same target
+    bl = str(tmp_path / "bl.json")
+    assert run_cli(bad, "--write-baseline", "--baseline", bl).returncode == 0
+    base = Baseline.load(bl)
+    assert base.entries
+    base.entries[0].reason = "audited: fixture keeps this on purpose"
+    base.save(bl)
+    assert run_cli(bad, "--write-baseline", "--baseline", bl).returncode == 0
+    kept = Baseline.load(bl)
+    assert any(e.reason == "audited: fixture keeps this on purpose"
+               for e in kept.entries)
+
+
+def test_write_baseline_refuses_rule_scoped_scan():
+    """--rules + --write-baseline would drop every other rule's audited
+    entries — refused like the partial-path case."""
+    r = run_cli("--rules", "XF301", "--write-baseline")
+    assert r.returncode == 3 and "--rules" in r.stderr
+
+
+def test_unrecorded_jit_catches_decorator_form(tmp_path):
+    """`@jax.jit` (and `@partial(jax.jit, ...)`) in a recorder-scoped
+    module bypasses compile accounting exactly like the call form."""
+    scoped = tmp_path / "xflow_tpu" / "serve"
+    scoped.mkdir(parents=True)
+    (scoped / "m.py").write_text(
+        "import jax\nfrom functools import partial\n\n\n"
+        "@jax.jit\ndef step(s):\n    return s\n\n\n"
+        "@partial(jax.jit, donate_argnums=(0,))\ndef step2(s):\n"
+        "    return s\n"
+    )
+    findings = lint(str(scoped / "m.py"), root=str(tmp_path))
+    assert [f.rule for f in findings] == ["XF204", "XF204"]
+    # lineno of a decorated FunctionDef is the `def` line
+    assert {f.line for f in findings} == {6, 11}
+
+
+def test_schema_doc_parser_ignores_fenced_blocks(tmp_path):
+    from xflow_tpu.analysis.passes.schema_drift import parse_schema_doc
+
+    doc = tmp_path / "d.md"
+    doc.write_text(
+        '## Records (`kind="thing"`)\n\n'
+        "```bash\n"
+        "# this comment must not read as a heading\n"
+        "| `not_a_key` | fenced tables are examples |\n"
+        "```\n\n"
+        "| field | meaning |\n"
+        "|---|---|\n"
+        "| `real_key` | documented |\n"
+    )
+    kinds, _stamp = parse_schema_doc(str(doc))
+    assert kinds["thing"] == {"real_key", "kind"}
+
+
+def test_baseline_round_trip(tmp_path):
+    p = str(tmp_path / "b.json")
+    base = Baseline([BaselineEntry("XF301", "x.py", "msg", reason="why")])
+    base.save(p)
+    loaded = Baseline.load(p)
+    assert [(e.rule, e.path, e.message, e.reason) for e in loaded.entries] \
+        == [("XF301", "x.py", "msg", "why")]
+    # a missing file is an empty baseline, not an error
+    assert Baseline.load(str(tmp_path / "nope.json")).entries == []
+
+
+# ------------------------------------------------------------ CLI contract
+
+
+def run_cli(*args, cwd=REPO_ROOT):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "xflowlint.py"),
+         *args],
+        capture_output=True, text=True, timeout=180, env=env, cwd=cwd)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = os.path.join(FIXTURES, "bad_jit_purity.py")
+    # new findings -> 1
+    r = run_cli(bad, "--no-baseline")
+    assert r.returncode == 1 and "XF101" in r.stdout
+    # everything baselined -> 0
+    bl = str(tmp_path / "bl.json")
+    r = run_cli(bad, "--write-baseline", "--baseline", bl)
+    assert r.returncode == 0
+    r = run_cli(bad, "--baseline", bl)
+    assert r.returncode == 0 and "suppressed by baseline" in r.stdout
+    # a fixed finding must leave the baseline -> 2 (baseline-shrink gate)
+    clean = os.path.join(FIXTURES, "good_clean.py")
+    r = run_cli(clean, "--baseline", bl)
+    assert r.returncode == 2 and "STALE baseline entry" in r.stdout
+    # --json carries the same verdicts
+    r = run_cli(bad, "--no-baseline", "--json")
+    data = json.loads(r.stdout)
+    assert data["new"] and data["stale_baseline"] == []
+
+
+def test_cli_full_repo_is_clean():
+    """The whole tree lints green against the checked-in baseline —
+    the same gate tools/smoke_lint.sh runs in CI."""
+    r = run_cli()
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_cli_unknown_rule_is_usage_error():
+    assert run_cli("--rules", "XF999").returncode == 3
+
+
+# ----------------------------------------- seeded violations (acceptance)
+
+SEEDS = [
+    # (rule, module to copy, seed snippet appended, marker)
+    ("XF101",
+     "xflow_tpu/models/predict.py",
+     "\nimport jax as _jax, time as _time\n\n\n"
+     "@_jax.jit\ndef _seeded(x):\n"
+     "    return x + _time.perf_counter()  # SEED\n",
+     "SEED"),
+    ("XF201",
+     "xflow_tpu/models/predict.py",
+     "\nimport jax as _jax\n\n\ndef _seeded(xs):\n"
+     "    for _x in xs:\n"
+     "        _jax.jit(lambda v: v)(_x)  # SEED\n",
+     "SEED"),
+    ("XF301",
+     "xflow_tpu/serve/metrics.py",
+     "\nimport threading as _th\n\n\nclass _Seeded:\n"
+     "    def __init__(self):\n"
+     "        self.n = 0\n"
+     "        _th.Thread(target=self._loop, daemon=True).start()\n"
+     "    def _loop(self):\n"
+     "        self.n += 1  # SEED\n"
+     "    def bump(self):\n"
+     "        self.n += 1\n",
+     "SEED"),
+    ("XF401",
+     "xflow_tpu/serve/metrics.py",
+     "\ndef _seeded(cfg: 'Config'):\n"
+     "    return cfg.serve.windw_ms  # SEED\n",
+     "SEED"),
+    ("XF501",
+     "xflow_tpu/serve/metrics.py",
+     "\ndef _seeded(app):\n"
+     "    app.append({'kind': 'serve', 'qqps': 1})  # SEED\n",
+     "{'kind': 'serve'"),
+]
+
+
+@pytest.mark.parametrize("rule,module,snippet,marker",
+                         SEEDS, ids=[s[0] for s in SEEDS])
+def test_seeded_violation_in_real_module_caught(tmp_path, rule, module,
+                                                snippet, marker):
+    """ISSUE 10 acceptance: seed one violation of each rule class into a
+    scratch copy of a REAL module; xflowlint reports the correct rule id
+    at the correct file:line."""
+    scratch = tmp_path / module
+    scratch.parent.mkdir(parents=True, exist_ok=True)
+    src = open(os.path.join(REPO_ROOT, module)).read()
+    shutil.copy(os.path.join(REPO_ROOT, module), scratch)
+    # the scratch copy must be CLEAN before seeding (real modules are)
+    assert lint(str(scratch)) == [], "unseeded copy must lint clean"
+    seeded_src = src + snippet
+    scratch.write_text(seeded_src)
+    want_line = next(i for i, ln in enumerate(seeded_src.splitlines(), 1)
+                     if marker in ln)
+    findings = lint(str(scratch))
+    assert findings and {f.rule for f in findings} == {rule}, findings
+    assert want_line in {f.line for f in findings}
+    assert findings[0].path.endswith(os.path.basename(module))
+
+
+# ----------------------------------------------------- schema/config seams
+
+
+def test_schema_doc_parser_covers_every_shipped_kind():
+    from xflow_tpu.analysis.passes.schema_drift import parse_schema_doc
+
+    kinds, stamp = parse_schema_doc(
+        os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md"))
+    for kind in ("compile", "serve", "span", "heartbeat", "watchdog"):
+        assert kind in kinds, f"doc lost its {kind} schema table"
+    assert {"ts", "rank", "run_id", "gen", "world"} <= stamp
+    assert "qps" in kinds["serve"] and "flagged_rank" in kinds["watchdog"]
+    assert "dur_ms" in kinds["span"] and "op_scopes" in kinds["compile"]
+
+
+def test_config_tree_parser_matches_dataclasses():
+    from xflow_tpu.analysis.passes.config_keys import ConfigTree
+
+    tree = ConfigTree.parse(os.path.join(REPO_ROOT, "xflow_tpu",
+                                         "config.py"))
+    assert set(tree.sections) == {"model", "optim", "data", "mesh",
+                                  "train", "serve"}
+    assert tree.resolve(("train", "log_every"))[0] == "ok"
+    assert tree.resolve(("optim", "ftrl", "alpha"))[0] == "ok"
+    assert tree.resolve(("num_slots",))[0] == "ok"  # Config property
+    assert tree.resolve(("train", "nope"))[0] == "bad"
+    assert tree.class_to_path["ServeConfig"] == ("serve",)
+
+
+def test_dead_key_reported_only_on_full_tree(tmp_path):
+    """XF402 needs the whole tree: partial lints must not scream."""
+    findings = lint("good_clean.py", rules=["XF402"])
+    assert findings == []
+
+
+# --------------------------------------------------------------- smoke gate
+
+
+def test_smoke_lint_script(tmp_path):
+    """tools/smoke_lint.sh: repo lint green, fixture corpus fires,
+    baseline growth/shrink mechanics, seeded-violation drill, ruff
+    layer when available — runnable standalone and from CI."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        ["bash", os.path.join(REPO_ROOT, "tools", "smoke_lint.sh"),
+         str(tmp_path / "work")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "smoke_lint: OK" in r.stdout

@@ -22,7 +22,7 @@
 # From pytest:   tests/test_elastic.py::test_smoke_elastic_script
 #
 # With no workdir argument a temp dir is created and cleaned up.
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env bash
+# Static-analysis smoke gate (docs/STATIC_ANALYSIS.md):
+#
+# 1. Repo-wide xflowlint against the checked-in baseline must be GREEN
+#    (zero unbaselined findings, zero stale baseline entries).
+# 2. The fixture corpus must behave: every bad_* fixture fires exactly
+#    its rule family (incl. the resurrected pre-PR 8 unlocked-appender
+#    bug), every good_*/suppress_* fixture stays silent.
+# 3. Baseline mechanics: a NEW finding exits 1; a baseline entry whose
+#    finding was fixed exits 2 (the baseline-shrink check — fixing a
+#    finding must also remove its entry).
+# 4. Seeded-violation drill: one violation of each rule class seeded
+#    into a scratch copy of a REAL module is caught with the correct
+#    rule id and file:line.
+# 5. ruff (the pinned generic-Python layer, pyproject.toml) runs clean
+#    when installed; skipped with a notice where the container lacks it.
+#
+# Standalone:    bash tools/smoke_lint.sh [workdir]
+# From pytest:   tests/test_xflowlint.py::test_smoke_lint_script
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+[ -n "$WORK" ] || WORK="$(mktemp -d /tmp/xflow_lint.XXXXXX)"
+mkdir -p "$WORK"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export PYTHONPATH="$ROOT${PYTHONPATH:+:$PYTHONPATH}"
+
+echo "smoke_lint: workdir $WORK"
+
+# ---- 1. repo-wide lint, baselined ----------------------------------------
+python tools/xflowlint.py
+echo "smoke_lint: repo-wide lint green"
+
+# ---- 2. fixture corpus ----------------------------------------------------
+FIX="tests/fixtures/xflowlint"
+expect_rules() { # expect_rules <fixture> <rule...>: exact rule-id set
+    local fixture="$1"; shift
+    local got want
+    # xflowlint exits 1 on findings BY DESIGN — that's what we assert
+    # on, so the substitution must not trip set -e/pipefail
+    got=$({ python tools/xflowlint.py "$FIX/$fixture" --no-baseline \
+        2>/dev/null || true; } | { grep -oE 'XF[0-9]+' || true; } \
+        | sort -u | tr '\n' ' ')
+    want=$(printf '%s\n' "$@" | sort -u | tr '\n' ' ')
+    [ "$got" = "$want" ] || {
+        echo "smoke_lint: $fixture: expected rules [$want] got [$got]"
+        exit 1; }
+}
+expect_silent() {
+    python tools/xflowlint.py "$FIX/$1" --no-baseline >/dev/null 2>&1 || {
+        echo "smoke_lint: $1 must lint clean"; exit 1; }
+}
+expect_rules bad_jit_purity.py XF101
+expect_rules bad_recompile.py XF201 XF202 XF203
+expect_rules bad_lockset.py XF301     # the pre-PR 8 appender, forever
+expect_rules bad_config.py XF401
+expect_rules bad_schema.py XF501 XF502
+expect_rules bad_shell.sh XF401 XF601
+expect_silent good_lockset.py
+expect_silent good_clean.py
+expect_silent suppress_line.py
+expect_silent suppress_file.py
+echo "smoke_lint: fixture corpus behaves (6 bad fire, 4 good silent)"
+
+# ---- 3. baseline growth + shrink mechanics --------------------------------
+BL="$WORK/baseline.json"
+rc=0; python tools/xflowlint.py "$FIX/bad_lockset.py" --no-baseline \
+    >/dev/null 2>&1 || rc=$?
+[ "$rc" -eq 1 ] || { echo "smoke_lint: new finding must exit 1, got $rc"; exit 1; }
+python tools/xflowlint.py "$FIX/bad_lockset.py" --write-baseline \
+    --baseline "$BL" >/dev/null
+python tools/xflowlint.py "$FIX/bad_lockset.py" --baseline "$BL" >/dev/null \
+    || { echo "smoke_lint: baselined lint must exit 0"; exit 1; }
+# "fix" the finding by linting the fixed fixture against the same
+# baseline: every entry is now stale -> the gate demands the baseline
+# shrink (exit 2)
+rc=0; python tools/xflowlint.py "$FIX/good_lockset.py" --baseline "$BL" \
+    >/dev/null 2>&1 || rc=$?
+[ "$rc" -eq 2 ] || {
+    echo "smoke_lint: stale baseline must exit 2 (shrink check), got $rc"
+    exit 1; }
+echo "smoke_lint: baseline growth/shrink mechanics OK (1 / 0 / 2)"
+
+# ---- 4. seeded violations in scratch copies of real modules ---------------
+SCRATCH="$WORK/scratch"
+seed() { # seed <rule> <module> <<< snippet-on-stdin
+    local rule="$1" module="$2"
+    local dst="$SCRATCH/$module"
+    mkdir -p "$(dirname "$dst")"
+    cp "$module" "$dst"
+    cat >>"$dst"
+    local line
+    line=$(awk '/SEED$/{print NR; exit}' "$dst")
+    local out
+    out=$(python tools/xflowlint.py "$dst" --no-baseline 2>/dev/null || true)
+    # herestrings, not `echo | grep -q`: pipefail + grep's early exit
+    # can SIGPIPE the producer and fail a passing check
+    grep -q "$rule" <<<"$out" || {
+        echo "smoke_lint: seeded $rule in $module not caught"; echo "$out"
+        exit 1; }
+    grep -qE "${module##*/}:$line: $rule" <<<"$out" || {
+        echo "smoke_lint: seeded $rule wanted ${module##*/}:$line"
+        echo "$out"; exit 1; }
+}
+seed XF101 xflow_tpu/models/predict.py <<'EOF'
+
+
+import time
+
+
+@jax.jit
+def _lint_seeded_purity(x):
+    return x + time.perf_counter()  # SEED
+EOF
+seed XF201 xflow_tpu/models/predict.py <<'EOF'
+
+
+def _lint_seeded_loop(xs):
+    for _x in xs:
+        jax.jit(lambda v: v)(_x)  # SEED
+EOF
+seed XF301 xflow_tpu/serve/metrics.py <<'EOF'
+
+
+class _LintSeededRace:
+    def __init__(self):
+        self.n = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self.n += 1  # SEED
+
+    def bump(self):
+        self.n += 1
+EOF
+seed XF401 xflow_tpu/serve/metrics.py <<'EOF'
+
+
+def _lint_seeded_key(cfg: "Config"):
+    return cfg.serve.windw_ms  # SEED
+EOF
+seed XF501 xflow_tpu/serve/metrics.py <<'EOF'
+
+
+def _lint_seeded_drift(app):
+    app.append({"kind": "serve", "qqps": 1})  # SEED
+EOF
+echo "smoke_lint: seeded-violation drill OK (5 rule classes, exact file:line)"
+
+# ---- 5. ruff: the pinned generic-Python layer -----------------------------
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+    echo "smoke_lint: ruff layer green ($(ruff --version))"
+else
+    echo "smoke_lint: ruff not installed — generic layer SKIPPED" \
+         "(pip install -e '.[lint]' to enable; pinned in pyproject.toml)"
+fi
+
+echo "smoke_lint: OK"

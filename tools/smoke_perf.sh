@@ -18,7 +18,7 @@
 # From pytest:   tests/test_perf_tools.py::test_smoke_perf_script
 #
 # With no workdir argument a temp dir is created and cleaned up.
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 

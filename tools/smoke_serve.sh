@@ -21,7 +21,7 @@
 #
 # Standalone:    bash tools/smoke_serve.sh [workdir]
 # From pytest:   tests/test_serve.py::test_smoke_serve_script
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 
@@ -183,8 +183,11 @@ python tools/metrics_report.py "$WORK/run_serve" --check
 grep -q '"event": "reload"' "$WORK/run_serve/serve_rank0.jsonl" || {
     echo "smoke_serve: no reload event in the serve stream"; exit 1; }
 # the server-side bench record agrees the run served traffic
+# (capture-then-grep: `| grep -q` + pipefail can SIGPIPE the producer)
 python tools/metrics_report.py "$WORK/run_serve" --bench-json - \
-    | grep -q serve_qps || { echo "smoke_serve: no serve bench record"; exit 1; }
+    >"$WORK/serve_bench_record.json"
+grep -q serve_qps "$WORK/serve_bench_record.json" \
+    || { echo "smoke_serve: no serve bench record"; exit 1; }
 
 # repo-root hygiene: running the tools from the root must leave no
 # stray artifact dirs behind (tools/__pycache__ and friends)

@@ -33,7 +33,7 @@
 #
 # Standalone:    bash tools/smoke_serve_fleet.sh [workdir]
 # From pytest:   tests/test_serve_fleet.py::test_smoke_serve_fleet_script
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 
@@ -208,7 +208,9 @@ grep -q '"event": "circuit_open"' "$WORK/run_fleet/serve_router.jsonl" || {
     echo "smoke_serve_fleet: no circuit_open event (kill never ejected)"; exit 1; }
 grep -q '"event": "circuit_close"' "$WORK/run_fleet/serve_router.jsonl" || {
     echo "smoke_serve_fleet: no circuit_close event (rejoin never closed)"; exit 1; }
-cat "$WORK/run_fleet"/serve_replica*.jsonl | grep -q '"event": "reload_failed"' || {
+# direct grep, not `cat | grep -q`: under pipefail grep's early exit
+# SIGPIPEs cat and fails the pipeline even when the event IS there
+grep -q '"event": "reload_failed"' "$WORK/run_fleet"/serve_replica*.jsonl || {
     echo "smoke_serve_fleet: no reload_failed (corrupt commit went unnoticed)"; exit 1; }
 grep -q '"gen": 1' "$WORK/run_fleet/serve_replica1.jsonl" || {
     echo "smoke_serve_fleet: replica 1 has no restart-generation-1 records"; exit 1; }

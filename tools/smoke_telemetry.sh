@@ -12,7 +12,7 @@
 # From pytest:   tests/test_telemetry.py::test_smoke_telemetry_script
 #
 # With no workdir argument a temp dir is created and cleaned up.
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 

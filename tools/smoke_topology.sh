@@ -28,7 +28,7 @@
 #
 # Standalone:    bash tools/smoke_topology.sh [workdir]
 # From pytest:   tests/test_topology.py::test_smoke_topology_script
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 

@@ -40,7 +40,7 @@
 #
 # Standalone:    bash tools/smoke_trace.sh [workdir]
 # From pytest:   tests/test_request_trace.py::test_smoke_trace_script
-set -eu
+set -euo pipefail
 cd "$(dirname "$0")/.."
 ROOT="$(pwd)"
 
@@ -143,15 +143,22 @@ drain_fleet() {
 }
 
 # ---- 2. overhead A/B: solo serve, alternating off/traced pairs ------------
-# one solo bench: solo_bench <label> <bench.json out> <serve --set...> <bench extra...>
+# one solo bench: solo_bench <label> <bench.json out> <trace sample rate|''>
 solo_bench() {
-    local label="$1" bjson="$2" serve_extra="$3" bench_extra="$4"
+    local label="$1" bjson="$2" rate="$3"
+    # extras as ARRAYS, not word-split strings: quoted expansion stays
+    # glob/space-safe under `set -euo pipefail` ('' rate = untraced)
+    local serve_extra=() bench_extra=()
+    if [ -n "$rate" ]; then
+        serve_extra=(--set "serve.trace_sample_rate=$rate")
+        bench_extra=(--trace-sample-rate "$rate")
+    fi
     local sdir="$WORK/solo_$label"
     mkdir -p "$sdir"
     python -m xflow_tpu serve --checkpoint-dir "$SERVE_CK" "${MODEL_ARGS[@]}" \
         --port 0 --window-ms 3 --max-batch 64 --no-mesh \
         --metrics-path "$sdir/serve.jsonl" --set serve.metrics_every_s=5 \
-        $serve_extra \
+        "${serve_extra[@]}" \
         >"$sdir/ready.json" 2>"$sdir/serve.log" &
     SOLO_PID=$!
     for i in $(seq 1 240); do
@@ -166,22 +173,19 @@ solo_bench() {
         "$sdir/ready.json")
     python tools/serve_bench.py --url "http://127.0.0.1:$port" \
         --data "$WORK/reqs-00000" --duration 4 --concurrency 2 \
-        --rows-per-request 4 $bench_extra \
+        --rows-per-request 4 "${bench_extra[@]}" \
         --bench-json "$bjson" >"$sdir/report.json" 2>"$sdir/bench.log" || {
         echo "smoke_trace: solo bench ($label) failed"
         cat "$sdir/report.json" "$sdir/serve.log"; exit 1; }
     kill -TERM "$SOLO_PID"; wait "$SOLO_PID" || true
     SOLO_PID=""
 }
-solo_bench off1 "$WORK/bench_off1.json" "" ""
-solo_bench traced1 "$WORK/bench_traced1.json" \
-    "--set serve.trace_sample_rate=0.01" "--trace-sample-rate 0.01"
-solo_bench off2 "$WORK/bench_off2.json" "" ""
-solo_bench traced2 "$WORK/bench_traced2.json" \
-    "--set serve.trace_sample_rate=0.01" "--trace-sample-rate 0.01"
-solo_bench off3 "$WORK/bench_off3.json" "" ""
-solo_bench traced3 "$WORK/bench_traced3.json" \
-    "--set serve.trace_sample_rate=0.01" "--trace-sample-rate 0.01"
+solo_bench off1 "$WORK/bench_off1.json" ""
+solo_bench traced1 "$WORK/bench_traced1.json" 0.01
+solo_bench off2 "$WORK/bench_off2.json" ""
+solo_bench traced2 "$WORK/bench_traced2.json" 0.01
+solo_bench off3 "$WORK/bench_off3.json" ""
+solo_bench traced3 "$WORK/bench_traced3.json" 0.01
 if grep -q '"kind": "span"' "$WORK"/solo_off*/serve.jsonl; then
     echo "smoke_trace: rate-0 run emitted span records (must be byte-identical" \
          "to a pre-tracing stream)"; exit 1
@@ -209,8 +213,10 @@ unset XFLOW_FAULT_SERVE_DELAY_S XFLOW_FAULT_SERVE_REPLICA
 # runner the staggered reload itself may land after the bench window —
 # wait it out before draining (the gate below still requires the span)
 for i in $(seq 1 120); do
-    cat "$WORK/run_traced"/serve_replica*.jsonl 2>/dev/null \
-        | grep -q '"name": "reload"' && break
+    # grep the files directly: under pipefail, `cat | grep -q` turns a
+    # successful early match into a failed pipeline (cat dies SIGPIPE)
+    if grep -q '"name": "reload"' "$WORK/run_traced"/serve_replica*.jsonl \
+            2>/dev/null; then break; fi
     sleep 0.5
 done
 drain_fleet "$WORK/run_traced"
@@ -264,7 +270,9 @@ print("smoke_trace: drill OK "
 EOF
 
 # reload spans are on disk and the timeline overlays them
-cat "$WORK/run_traced"/serve_replica*.jsonl | grep -q '"name": "reload"' || {
+# direct grep, not `cat | grep -q`: under pipefail grep's early exit
+# SIGPIPEs cat and fails the pipeline even when the span IS there
+grep -q '"name": "reload"' "$WORK/run_traced"/serve_replica*.jsonl || {
     echo "smoke_trace: no reload span (hot swap never traced)"; exit 1; }
 grep -q "reload" "$WORK/trace_report.txt" || {
     echo "smoke_trace: --timeline never overlaid the reload"; exit 1; }
@@ -303,8 +311,11 @@ EOF
 
 # standalone, BENCH_OUT sits in the repo root (the per-PR record);
 # under pytest, in the workdir — the ledger scans wherever it landed
+# capture-then-grep (not `| grep -q`): pipefail + grep's early exit
+# would SIGPIPE the ledger mid-print and fail a passing check
 python tools/perf_ledger.py --root "$(dirname "$BENCH_OUT")" --markdown - \
-    | grep -q "BENCH_TRACE.json" || {
+    >"$WORK/ledger.md"
+grep -q "BENCH_TRACE.json" "$WORK/ledger.md" || {
     echo "smoke_trace: BENCH_TRACE.json never reached the perf ledger"; exit 1; }
 
 # repo-root hygiene: running the tools from the root must leave no

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""xflowlint — project-native static analysis for xflow-tpu.
+
+Runs the xflow_tpu/analysis passes (docs/STATIC_ANALYSIS.md) over the
+repo (or explicit paths) and gates against the checked-in baseline:
+
+    python tools/xflowlint.py                       # full repo, baselined
+    python tools/xflowlint.py xflow_tpu/serve       # subset (no dead-key)
+    python tools/xflowlint.py --rules XF301         # one rule family
+    python tools/xflowlint.py --write-baseline      # re-record legacy set
+    python tools/xflowlint.py --list-rules
+
+Exit codes (tools/smoke_lint.sh relies on these):
+    0  clean — no unbaselined findings, no stale baseline entries
+    1  NEW findings (not in the baseline)
+    2  STALE baseline entries (a fixed finding must leave the baseline)
+    3  usage / internal error
+
+The baseline (tools/xflowlint_baseline.json) makes the gate fail on
+*growth*, not existence; inline `# xflowlint: disable=RULE` handles
+intentional single sites (with a nearby comment saying why).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from xflow_tpu.analysis.core import (  # noqa: E402
+    PASS_REGISTRY, Baseline, Project, run_passes,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "xflowlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="xflowlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the whole repo)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root (anchors config.py / OBSERVABILITY.md)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default {DEFAULT_BASELINE} on "
+                         "full-repo runs; none on explicit paths)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings as the new baseline "
+                         "(audit reasons by hand afterwards)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (e.g. XF101,XF301)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    import xflow_tpu.analysis.passes  # noqa: F401  (register)
+
+    if args.list_rules:
+        for name, (_fn, rules) in sorted(PASS_REGISTRY.items()):
+            print(f"{name}: {', '.join(rules)}")
+        return 0
+
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r for _n, (_f, rs) in PASS_REGISTRY.items() for r in rs}
+        bad = only - known - {"XF001"}
+        if bad:
+            print(f"xflowlint: unknown rule(s): {', '.join(sorted(bad))}",
+                  file=sys.stderr)
+            return 3
+
+    try:
+        project = Project.load(args.root, args.paths or None)
+    except OSError as e:
+        print(f"xflowlint: {e}", file=sys.stderr)
+        return 3
+    findings = run_passes(project, only_rules=only)
+
+    baseline_path = args.baseline
+    if baseline_path is None and project.full_tree and not args.no_baseline:
+        baseline_path = DEFAULT_BASELINE
+    baseline = Baseline() if (args.no_baseline or not baseline_path) \
+        else Baseline.load(baseline_path)
+
+    if args.write_baseline:
+        if not project.full_tree and args.baseline is None:
+            print(
+                "xflowlint: --write-baseline over an explicit path set "
+                "would overwrite the repo-wide baseline with a PARTIAL "
+                "scan (every entry outside the scanned paths would be "
+                "dropped); pass an explicit --baseline file",
+                file=sys.stderr,
+            )
+            return 3
+        if only is not None:
+            print(
+                "xflowlint: --write-baseline with --rules would drop "
+                "every other rule's baseline entries (a rule-scoped "
+                "scan sees none of their findings); rerun without "
+                "--rules",
+                file=sys.stderr,
+            )
+            return 3
+        target = baseline_path or DEFAULT_BASELINE
+        out = Baseline()
+        from xflow_tpu.analysis.core import BaselineEntry
+
+        seen = set()
+        # reasons carry over from the TARGET file (the baseline actually
+        # being rewritten), so an audited reason survives regeneration
+        reasons = {(e.rule, e.path, e.message): e.reason
+                   for e in Baseline.load(target).entries}
+        for f in findings:
+            fp = f.fingerprint()
+            if fp in seen:
+                continue
+            seen.add(fp)
+            out.entries.append(BaselineEntry(
+                rule=f.rule, path=f.path, message=f.message,
+                reason=reasons.get(fp, "TODO: justify or fix")))
+        out.save(target)
+        print(f"xflowlint: wrote {len(out.entries)} baseline entr"
+              f"{'y' if len(out.entries) == 1 else 'ies'} to {target}")
+        return 0
+
+    new, based, stale = baseline.split(findings, only_rules=only)
+
+    if args.json:
+        import dataclasses
+
+        print(json.dumps({
+            "new": [dataclasses.asdict(f) for f in new],
+            "baselined": len(based),
+            "stale_baseline": [
+                {"rule": e.rule, "path": e.path, "message": e.message}
+                for e in stale],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if based:
+            print(f"xflowlint: {len(based)} finding(s) suppressed by "
+                  f"baseline ({baseline_path})")
+        for e in stale:
+            print(f"xflowlint: STALE baseline entry (finding no longer "
+                  f"fires — remove it): {e.path}: {e.rule}: {e.message}")
+    n_files = len(project.modules) + len(project.shell_scripts)
+    summary = (f"xflowlint: {n_files} files, {len(findings)} finding(s): "
+               f"{len(new)} new, {len(based)} baselined, "
+               f"{len(stale)} stale baseline entr"
+               f"{'y' if len(stale) == 1 else 'ies'}")
+    print(summary, file=sys.stderr)
+    if new:
+        return 1
+    if stale:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""xflowlint: project-native static analysis for xflow-tpu.
+
+The reference xflow shipped zero correctness tooling — races and
+protocol drift were found by crashing in production (PAPER.md, the
+hand-rolled multithreaded workers). This repo has nine PRs of
+invariants that are cheap to state and expensive to re-discover at
+runtime: jit bodies must be pure (PR 2's perf_counter rule), every
+program compiles exactly once per signature (PR 7's CompileRecorder
+contract), cross-thread attributes are touched under a lock (the PR 8
+JsonlAppender interleave), every `cfg.section.key` read resolves to a
+config.py default, and every record flowing into the stamped JSONL
+appender matches the schema tables in docs/OBSERVABILITY.md.
+
+`xflow_tpu/analysis/` enforces those mechanically, from the AST alone
+(stdlib `ast`; no new dependencies, nothing is imported or
+executed), so `tools/smoke_lint.sh` can gate them in CI before the
+unified-engine churn the ROADMAP plans. See docs/STATIC_ANALYSIS.md
+for the rule catalog and the suppression/baseline workflow.
+
+Layout:
+- core.py      — Finding model, suppression parsing, baseline files,
+                 the Project/Module source graph every pass shares
+- passes/      — one module per rule family (jit purity, recompile
+                 hazards, thread-safety lockset, config cross-check,
+                 JSONL schema drift, shell strict-mode)
+- tools/xflowlint.py — the CLI (repo-wide lint, --baseline gating)
+"""
+
+from xflow_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Module,
+    Project,
+    run_passes,
+)

@@ -1,0 +1,360 @@
+"""Shared analyzer core: source model, finding model, suppressions,
+baseline files, and the pass registry/driver.
+
+Everything works from text + `ast` — the analyzer never imports the
+code under analysis (a lint run must not depend on jax being
+importable, and must be able to lint a scratch copy of a module
+without executing it).
+
+Suppressions (docs/STATIC_ANALYSIS.md):
+- `# xflowlint: disable=XF101` on the offending line silences the
+  named rule(s) (comma-separated) for that line only;
+- `# xflowlint: disable-file=XF201` anywhere in a file silences the
+  rule(s) for the whole file (use for tools where a rule's premise —
+  e.g. "jit compiles more than once" — is the point of the file).
+
+Baseline (`tools/xflowlint_baseline.json`): legacy findings are
+recorded as (rule, path, message) entries with a human reason, so the
+CI gate fails on *growth* (a new finding) and on *staleness* (a fixed
+finding whose entry was not removed) rather than on existence. Line
+numbers are deliberately not part of the fingerprint — an unrelated
+edit above a baselined finding must not break the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+SUPPRESS_RE = re.compile(
+    r"#\s*xflowlint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: rule id, location, message, and a fix hint."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated (stable across machines)
+    line: int
+    message: str
+    hint: str = ""
+    severity: str = "error"
+
+    def fingerprint(self) -> tuple:
+        """Baseline identity: line numbers excluded on purpose."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        sev = "" if self.severity == "error" else f" [{self.severity}]"
+        out = f"{self.path}:{self.line}: {self.rule}{sev}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class _SuppressionTable:
+    """Shared `# xflowlint: disable[-file]=` semantics — ONE parser and
+    ONE `suppressed()` so Python and shell sources cannot drift (the
+    `all` wildcard behaves identically in both)."""
+
+    def _parse_suppressions(self) -> None:
+        self.line_suppress: dict[int, set] = {}
+        self.file_suppress: set = set()
+        for i, line in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file":
+                self.file_suppress |= rules
+            else:
+                self.line_suppress.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppress or "all" in self.file_suppress:
+            return True
+        at = self.line_suppress.get(line, ())
+        return rule in at or "all" in at
+
+
+class Module(_SuppressionTable):
+    """One parsed Python source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:  # surfaced as its own finding (XF001)
+            self.syntax_error = f"{e.msg} (line {e.lineno})"
+        self._parse_suppressions()
+
+
+class ShellScript(_SuppressionTable):
+    """One shell script (config cross-check + strict-mode pass input)."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self._parse_suppressions()
+
+
+DEFAULT_PY_GLOBS = (
+    "xflow_tpu/**/*.py",
+    "tools/*.py",
+    "bench.py",
+    "conftest.py",
+)
+DEFAULT_SH_GLOBS = ("tools/*.sh",)
+EXCLUDE_DIRS = ("__pycache__", ".git", ".pytest_cache", "tests/fixtures")
+
+
+class Project:
+    """The source set one lint run sees, with the repo-root anchors the
+    cross-checking passes need (config.py, docs/OBSERVABILITY.md)."""
+
+    def __init__(self, root: str, modules: list, shell_scripts: list,
+                 full_tree: bool = True):
+        self.root = root
+        self.modules: list[Module] = modules
+        self.shell_scripts: list[ShellScript] = shell_scripts
+        # dead-key analysis (XF402) is only sound when the whole tree
+        # was scanned — a partial lint would report every key dead
+        self.full_tree = full_tree
+        self.config_path = os.path.join(root, "xflow_tpu", "config.py")
+        self.schema_doc_path = os.path.join(root, "docs", "OBSERVABILITY.md")
+
+    @classmethod
+    def load(cls, root: str, paths: Optional[Iterable[str]] = None) -> "Project":
+        """Load the default source set under `root`, or an explicit
+        file/dir list (relative to cwd or absolute)."""
+        root = os.path.abspath(root)
+        py_files: list[str] = []
+        sh_files: list[str] = []
+        full_tree = not paths
+        if paths:
+            for p in paths:
+                p = os.path.abspath(p)
+                if os.path.isdir(p):
+                    for dirpath, dirnames, filenames in os.walk(p):
+                        dirnames[:] = [d for d in dirnames
+                                       if d not in ("__pycache__", ".git")]
+                        for fn in sorted(filenames):
+                            fp = os.path.join(dirpath, fn)
+                            if fn.endswith(".py"):
+                                py_files.append(fp)
+                            elif fn.endswith(".sh"):
+                                sh_files.append(fp)
+                elif p.endswith(".sh"):
+                    sh_files.append(p)
+                else:
+                    py_files.append(p)
+        else:
+            for pat in DEFAULT_PY_GLOBS:
+                py_files.extend(_glob_under(root, pat))
+            for pat in DEFAULT_SH_GLOBS:
+                sh_files.extend(_glob_under(root, pat))
+        modules = []
+        for fp in sorted(set(py_files)):
+            rel = _rel_to(fp, root)
+            modules.append(Module(fp, rel, _read(fp)))
+        scripts = []
+        for fp in sorted(set(sh_files)):
+            rel = _rel_to(fp, root)
+            scripts.append(ShellScript(fp, rel, _read(fp)))
+        return cls(root, modules, scripts, full_tree=full_tree)
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def _rel_to(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:
+        rel = path
+    return path if rel.startswith("..") else rel
+
+
+def _glob_under(root: str, pattern: str) -> list:
+    """`**`-aware glob rooted at `root`, skipping EXCLUDE_DIRS."""
+    out = []
+    if "**" in pattern:
+        head = pattern.split("**", 1)[0].rstrip("/")
+        base = os.path.join(root, head) if head else root
+        tail = pattern.split("**", 1)[1].lstrip("/")
+        for dirpath, dirnames, filenames in os.walk(base):
+            rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            if any(x in rel_dir.split("/") for x in ("__pycache__",)):
+                continue
+            for fn in filenames:
+                rel = (rel_dir + "/" + fn) if rel_dir != "." else fn
+                if fnmatch.fnmatch(fn, tail) or fnmatch.fnmatch(rel, pattern):
+                    out.append(os.path.join(dirpath, fn))
+    else:
+        import glob as _glob
+
+        out = _glob.glob(os.path.join(root, pattern))
+    return [p for p in out if not _excluded(p)]
+
+
+def _excluded(path: str) -> bool:
+    """EXCLUDE_DIRS entries match path components ('__pycache__') or
+    '/'-joined sub-paths ('tests/fixtures')."""
+    norm = path.replace(os.sep, "/")
+    comps = norm.split("/")
+    for x in EXCLUDE_DIRS:
+        if "/" in x:
+            if f"/{x}/" in f"/{norm}/":
+                return True
+        elif x in comps:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- baseline
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    message: str
+    reason: str = ""
+
+
+class Baseline:
+    """Checked-in legacy findings: the gate fails on growth (new
+    finding) and staleness (entry whose finding no longer fires)."""
+
+    def __init__(self, entries: Optional[list] = None):
+        self.entries: list[BaselineEntry] = list(entries or [])
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        entries = [
+            BaselineEntry(
+                rule=e["rule"], path=e["path"], message=e["message"],
+                reason=e.get("reason", ""),
+            )
+            for e in data.get("entries", [])
+        ]
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        data = {
+            "comment": (
+                "xflowlint baseline: legacy findings accepted with a "
+                "reason. The CI gate fails on NEW findings and on STALE "
+                "entries (fixed findings must be removed from here). "
+                "Regenerate with tools/xflowlint.py --write-baseline "
+                "after auditing every entry."
+            ),
+            "entries": [dataclasses.asdict(e) for e in sorted(
+                self.entries, key=lambda e: (e.path, e.rule, e.message))],
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def split(self, findings: list, only_rules: Optional[set] = None) -> tuple:
+        """-> (new_findings, baselined_findings, stale_entries).
+
+        `only_rules` scopes the STALENESS check to entries of the rules
+        that actually ran — a `--rules XF301` run must not report an
+        XF401 entry stale just because the config pass was skipped."""
+        fps = {}
+        for f in findings:
+            fps.setdefault((f.rule, f.path, f.message), []).append(f)
+        known = {(e.rule, e.path, e.message) for e in self.entries}
+        new = [f for f in findings
+               if (f.rule, f.path, f.message) not in known]
+        base = [f for f in findings
+                if (f.rule, f.path, f.message) in known]
+        stale = [e for e in self.entries
+                 if (e.rule, e.path, e.message) not in fps
+                 and (only_rules is None or e.rule in only_rules)]
+        return new, base, stale
+
+
+# ------------------------------------------------------------ pass driver
+
+# populated by xflow_tpu.analysis.passes at import; maps pass name ->
+# (runner, rule ids) so the CLI can list and select
+PASS_REGISTRY: dict[str, tuple] = {}
+
+
+def register_pass(name: str, rules: tuple) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        PASS_REGISTRY[name] = (fn, rules)
+        return fn
+
+    return deco
+
+
+def run_passes(project: Project, only_rules: Optional[set] = None) -> list:
+    """Run every registered pass, apply suppressions, return findings
+    sorted by (path, line, rule). Unparseable files yield XF001."""
+    import xflow_tpu.analysis.passes  # noqa: F401  (registers passes)
+
+    findings: list[Finding] = []
+    sources = {m.relpath: m for m in project.modules}
+    sources.update({s.relpath: s for s in project.shell_scripts})
+    for mod in project.modules:
+        if mod.syntax_error is None:
+            continue
+        # XF001 honors --rules and suppressions like any other rule
+        # (the suppression table parses line-wise, so it exists even
+        # for files the AST parser rejected)
+        if only_rules is not None and "XF001" not in only_rules:
+            continue
+        if mod.suppressed("XF001", 1):
+            continue
+        findings.append(Finding(
+            rule="XF001", path=mod.relpath, line=1,
+            message=f"syntax error: {mod.syntax_error}",
+            hint="xflowlint needs parseable sources to analyze",
+        ))
+    for name, (runner, rules) in sorted(PASS_REGISTRY.items()):
+        if only_rules is not None and not (set(rules) & only_rules):
+            continue
+        for f in runner(project):
+            if only_rules is not None and f.rule not in only_rules:
+                continue
+            src = sources.get(f.path)
+            if src is not None and src.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    # dedup: two passes (or one regex matching twice on a line) must
+    # not double-report one defect
+    seen: set = set()
+    unique = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                             f.message)):
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique

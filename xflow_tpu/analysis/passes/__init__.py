@@ -1,0 +1,12 @@
+"""xflowlint passes. Importing this package registers every pass with
+core.PASS_REGISTRY (the driver imports it lazily so a partial install
+never breaks `import xflow_tpu.analysis`)."""
+
+from xflow_tpu.analysis.passes import (  # noqa: F401
+    config_keys,
+    jit_purity,
+    lockset,
+    recompile,
+    schema_drift,
+    shell,
+)

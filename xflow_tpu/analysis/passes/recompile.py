@@ -1,0 +1,294 @@
+"""XF2xx recompile hazards: patterns that silently thrash the jit cache.
+
+PR 7's CompileRecorder turned "each (program, signature) compiles
+exactly once per run" into a runtime `--check` gate; these rules catch
+the same class of bug before the code ever runs:
+
+- XF201 jit-in-loop: a `jax.jit(...)` (or immediately-invoked
+  `jax.jit(f)(x)`) inside a for/while body builds a FRESH callable —
+  and with it a fresh trace + compile — on every iteration. The cache
+  keys on the function object; a new object never hits.
+- XF202 varying-static-argument: a callable jitted with
+  `static_argnums`/`static_argnames` recompiles once per DISTINCT
+  value of each static argument. Passing a loop induction variable, or
+  different literals across call sites, in a static slot is a
+  compile-per-step bug.
+- XF203 unhashable-static-argument: a list/dict/set literal in a
+  static slot raises (static args are cache keys and must hash) — at
+  call time, far from the jit site that declared it static.
+- XF204 unrecorded-jit: in the engine/serve modules, every jit must
+  route through `telemetry.CompileRecorder.wrap` so the exactly-once
+  contract stays observable (docs/OBSERVABILITY.md "Compile
+  accounting"). A bare `jax.jit` there compiles invisibly — the
+  metrics stream cannot prove it didn't recompile.
+"""
+
+from __future__ import annotations
+
+import ast
+from xflow_tpu.analysis import astutil
+from xflow_tpu.analysis.core import Finding, Project, register_pass
+
+RULES = ("XF201", "XF202", "XF203", "XF204")
+
+JIT_CALLS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+# modules where PR 7's recorder contract applies: every jitted program
+# must be wrapped so compile accounting sees it
+RECORDER_SCOPED = (
+    "xflow_tpu/train/step.py",
+    "xflow_tpu/parallel/train_step.py",
+    "xflow_tpu/parallel/sorted_sharded.py",
+    "xflow_tpu/parallel/sorted_fullshard.py",
+    "xflow_tpu/models/predict.py",
+    "xflow_tpu/serve/",
+)
+
+
+def _static_spec(call: ast.Call) -> tuple:
+    """(static positions, static names) declared on a jit call."""
+    nums: list = []
+    names: list = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for it in items:
+                if isinstance(it, ast.Constant) and isinstance(it.value, int):
+                    nums.append(it.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for it in items:
+                s = astutil.const_str(it)
+                if s:
+                    names.append(s)
+    return nums, names
+
+
+def _loop_vars_for(node: ast.AST, parents: dict) -> set:
+    """Names bound as for-loop targets in the SAME scope as `node`
+    (its enclosing function, or the module top level) — a parameter
+    sharing a name with an unrelated loop variable in some other
+    function must not read as a loop variable here."""
+    owner = astutil.enclosing(
+        node, parents, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+    if owner is None:
+        # module scope: walk up to the root
+        owner = node
+        while parents.get(owner) is not None:
+            owner = parents[owner]
+    out: set = set()
+    for sub in astutil.walk_scope(owner):
+        if isinstance(sub, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(sub.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+@register_pass("recompile-hazard", RULES)
+def run(project: Project) -> list:
+    findings = []
+    for mod in project.modules:
+        if mod.tree is None:
+            continue
+        parents = astutil.parent_map(mod.tree)
+        aliases = astutil.import_aliases(mod.tree)
+        # name -> the jit Call that produced it (for static-arg call sites)
+        jitted: dict = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if astutil.canonical(astutil.call_name(node.value),
+                                     aliases) in JIT_CALLS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jitted[tgt.id] = node.value
+
+        in_scope = any(mod.relpath.startswith(p) or mod.relpath == p
+                       for p in RECORDER_SCOPED)
+        wrapped_names: set = set()
+        wrapped_factories: set = set()
+        if in_scope:
+            # names passed to a `.wrap(...)` call anywhere in the module
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and node.func.attr == "wrap":
+                    for arg in node.args:
+                        nm = astutil.dotted(arg)
+                        if nm:
+                            wrapped_names.add(nm)
+            # factory pattern: `jitted = build(...)` then
+            # `recorder.wrap(name, jitted)` — a jit RETURNED from
+            # `build` is accounted for at the call site
+            for node in ast.walk(mod.tree):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    cn = astutil.call_name(node.value)
+                    if cn is None or "." in cn:
+                        continue
+                    for tgt in node.targets:
+                        nm = astutil.dotted(tgt)
+                        if nm and nm in wrapped_names:
+                            wrapped_factories.add(cn)
+
+        # decorator-form jit in recorder-scoped modules: `@jax.jit` (or
+        # `@partial(jax.jit, ...)`) on a def whose name never reaches a
+        # `.wrap(...)` call bypasses compile accounting just as surely
+        # as the call form below
+        if in_scope:
+            from xflow_tpu.analysis.passes.jit_purity import _is_jit_decorator
+
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if not any(_is_jit_decorator(d, aliases)
+                           for d in node.decorator_list):
+                    continue
+                if node.name in wrapped_names:
+                    continue
+                findings.append(Finding(
+                    rule="XF204", path=mod.relpath, line=node.lineno,
+                    message="decorator-jitted function not routed through "
+                            "CompileRecorder.wrap — compile accounting "
+                            "cannot see it (exactly-once contract, "
+                            "docs/OBSERVABILITY.md)",
+                    hint="drop the decorator and wrap explicitly: "
+                         "`recorder.wrap(\"<program>\", jax.jit(fn))`",
+                ))
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = astutil.canonical(astutil.call_name(node), aliases)
+            if cn not in JIT_CALLS:
+                continue
+            # ---- XF201: jit constructed per loop iteration ------------
+            if astutil.in_loop(node, parents):
+                findings.append(Finding(
+                    rule="XF201", path=mod.relpath, line=node.lineno,
+                    message=f"`{cn}(...)` inside a loop builds a fresh "
+                            "callable — and recompiles — every iteration",
+                    hint="hoist the jit out of the loop (the cache keys on "
+                         "the function OBJECT; a new object never hits)",
+                ))
+            # immediately-invoked jit inside any function that also sits
+            # in a loop is covered above; bare immediate invocation at
+            # module level compiles once and is left alone.
+            # ---- XF204: unrecorded jit in recorder-scoped modules -----
+            if in_scope:
+                parent = parents.get(node)
+                ok = False
+                # direct: recorder.wrap("name", jax.jit(f))
+                enc = astutil.enclosing(node, parents, (ast.Call,))
+                if enc is not None and isinstance(enc.func, ast.Attribute) \
+                        and enc.func.attr == "wrap":
+                    ok = True
+                # assigned then wrapped: fn = jax.jit(f); recorder.wrap(fn)
+                if isinstance(parent, ast.Assign):
+                    for tgt in parent.targets:
+                        nm = astutil.dotted(tgt)
+                        if nm and nm in wrapped_names:
+                            ok = True
+                # returned from a factory whose results get wrapped:
+                # `def build(): return jax.jit(f)` + `x = build()` +
+                # `recorder.wrap(name, x)`
+                if not ok and isinstance(parent, ast.Return):
+                    fn = astutil.enclosing(
+                        node, parents, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                    if fn is not None and fn.name in wrapped_factories:
+                        ok = True
+                if not ok:
+                    findings.append(Finding(
+                        rule="XF204", path=mod.relpath, line=node.lineno,
+                        message="jit program not routed through "
+                                "CompileRecorder.wrap — compile accounting "
+                                "cannot see it (exactly-once contract, "
+                                "docs/OBSERVABILITY.md)",
+                        hint="wrap it: `recorder.wrap(\"<program>\", jitted)`"
+                             " when a recorder is configured",
+                    ))
+
+        # ---- XF202/XF203: call sites of statically-jitted names -------
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = astutil.dotted(node.func)
+            if fname not in jitted:
+                continue
+            jcall = jitted[fname]
+            nums, names = _static_spec(jcall)
+            if not nums and not names:
+                continue
+            loop_vars = _loop_vars_for(node, parents)
+            for idx in nums:
+                if idx < len(node.args):
+                    arg = node.args[idx]
+                    _check_static_arg(findings, mod, node, fname, idx, arg,
+                                      loop_vars)
+            for kw in node.keywords:
+                if kw.arg in names:
+                    _check_static_arg(findings, mod, node, fname, kw.arg,
+                                      kw.value, loop_vars)
+        # cross-site varying literals in static slots
+        _varying_literals(findings, mod, jitted)
+    return findings
+
+
+def _check_static_arg(findings, mod, call, fname, slot, arg, loop_vars):
+    if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+        findings.append(Finding(
+            rule="XF203", path=mod.relpath, line=call.lineno,
+            message=f"unhashable {type(arg).__name__.lower()} literal in "
+                    f"static slot {slot!r} of jitted `{fname}` — static "
+                    "args are cache keys and must hash",
+            hint="pass a tuple (or hoist the structure out of the static "
+                 "signature)",
+        ))
+    elif isinstance(arg, ast.Name) and arg.id in loop_vars:
+        findings.append(Finding(
+            rule="XF202", path=mod.relpath, line=call.lineno,
+            message=f"loop variable `{arg.id}` in static slot {slot!r} of "
+                    f"jitted `{fname}` — recompiles once per loop value",
+            hint="make the argument dynamic (traced) or hoist the loop "
+                 "into the program (lax.scan / fori_loop)",
+        ))
+
+
+def _varying_literals(findings, mod, jitted) -> None:
+    """Two call sites passing DIFFERENT literals in one static slot ->
+    one compile per value (XF202)."""
+    if not jitted:
+        return
+    sites: dict = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = astutil.dotted(node.func)
+        if fname not in jitted:
+            continue
+        nums, names = _static_spec(jitted[fname])
+        for idx in nums:
+            if idx < len(node.args):
+                arg = node.args[idx]
+                if isinstance(arg, ast.Constant):
+                    sites.setdefault((fname, idx), []).append(
+                        (node.lineno, arg.value))
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(kw.value, ast.Constant):
+                sites.setdefault((fname, kw.arg), []).append(
+                    (kw.value.lineno, kw.value.value))
+    for (fname, slot), vals in sites.items():
+        distinct = {repr(v) for _ln, v in vals}
+        if len(distinct) > 1:
+            line = min(ln for ln, _v in vals)
+            findings.append(Finding(
+                rule="XF202", path=mod.relpath, line=line,
+                message=f"jitted `{fname}` called with "
+                        f"{len(distinct)} distinct literals in static slot "
+                        f"{slot!r} — one compile per value",
+                hint="if the values are genuinely few this may be intended;"
+                     " otherwise make the argument dynamic",
+            ))

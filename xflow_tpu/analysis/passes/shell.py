@@ -1,0 +1,68 @@
+"""XF601 shell strict mode: smoke scripts must fail loudly.
+
+The smoke scripts are CI gates: a script without `set -euo pipefail`
+can drop a failing pipeline stage (`cmd | tee log` swallows cmd's
+exit), read an unset variable as empty (`rm -rf "$WORK/"` with WORK
+unset), or keep running past a failed step and green-light a broken
+tree. Built while wiring the config cross-check's script scanner
+(ISSUE 10); the unquoted-variable sweep is manual — bash quoting is
+not statically decidable without a real parser.
+
+- XF601 shell-strict-mode: the script does not establish
+  `set -euo pipefail` (in one line, or split across `set -e`/`set -u`/
+  `set -o pipefail`) before its first non-comment command.
+"""
+
+from __future__ import annotations
+
+import re
+
+from xflow_tpu.analysis.core import Finding, Project, register_pass
+
+RULE = "XF601"
+
+PIPEFAIL_RE = re.compile(r"-[a-zA-Z]*o\s+pipefail")
+
+
+def _flags(script) -> tuple:
+    """(has_e, has_u, has_pipefail, first_set_line). Handles combined
+    clusters (`set -euo pipefail` == `-e` + `-u` + `-o pipefail`) and
+    ORDER: only `set` lines seen before the first other command count —
+    strict mode established after fallible commands protects nothing
+    (the rule's own message says 'before its first non-comment
+    command')."""
+    e = u = pf = False
+    first = None
+    for i, line in enumerate(script.lines, 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if not (stripped == "set" or stripped.startswith("set ")):
+            break  # first real command: later `set` lines are too late
+        if first is None:
+            first = i
+        body = stripped[3:]
+        if PIPEFAIL_RE.search(body):
+            pf = True
+        for m in re.finditer(r"(?<!\S)-([a-zA-Z]+)", body):
+            e = e or "e" in m.group(1)
+            u = u or "u" in m.group(1)
+    return e, u, pf, first
+
+
+@register_pass("shell-strict-mode", (RULE,))
+def run(project: Project) -> list:
+    findings = []
+    for script in project.shell_scripts:
+        e, u, pf, first = _flags(script)
+        missing = [flag for ok, flag in
+                   ((e, "-e"), (u, "-u"), (pf, "-o pipefail")) if not ok]
+        if missing:
+            findings.append(Finding(
+                rule=RULE, path=script.relpath, line=first or 1,
+                message="script does not establish `set -euo pipefail` "
+                        f"(missing: {', '.join(missing)})",
+                hint="CI smoke scripts must die on the first failed "
+                     "command, unset variable, or failed pipeline stage",
+            ))
+    return findings

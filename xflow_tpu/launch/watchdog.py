@@ -243,6 +243,11 @@ class RunWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started = time.time()
+        # poll_once is both the _run-thread body AND a public test/
+        # launcher seam — serialize scans so two concurrent polls can
+        # never interleave _reported transitions into duplicate events
+        # (xflowlint XF301, the PR 8 unlocked-writer bug class)
+        self._poll_lock = threading.Lock()
         self._reported: dict = {}  # rank -> last reported status
         self.flagged: dict = {}  # rank -> worst status ever reported
 
@@ -254,74 +259,82 @@ class RunWatchdog:
 
     def poll_once(self, now: Optional[float] = None) -> list[dict]:
         """One scan (also the test seam): classify every rank and report
-        transitions."""
-        # generation-filtered: a relaunched attempt must not classify
-        # (and re-kill) on the PREVIOUS attempt's stale beats
-        beats = read_heartbeats(
-            self._run_dir, run_id=self._run_id or None, gen=self._gen
-        )
-        t = time.time() if now is None else now
-        # "missing" needs a startup grace: ranks open their heartbeat
-        # streams hundreds of ms apart, and a poll landing between the
-        # first and last start beat must not flag the slower ranks. A
-        # rank is only "missing" once the run has both produced beats
-        # AND outlived the dead threshold since this watchdog started.
-        expect = (
-            self._n
-            if beats and (t - self._started) > min(self._dead_after, 30.0)
-            else None
-        )
-        rows = classify(
-            beats,
-            t,
-            straggler_factor=self._factor,
-            dead_after_s=self._dead_after,
-            expected_ranks=expect,
-        )
-        for row in rows:
-            status = row["status"]
-            prev = self._reported.get(row["rank"], "ok")
-            # event payload keys deliberately avoid "rank"/"step": those
-            # would collide with the appender's launcher stamp and the
-            # report tool's per-stream step-monotonicity gate
-            payload = {
-                "flagged_rank": row["rank"],
-                "at_step": row["step"],
-                "max_step": row["max_step"],
-                "age_s": row["age_s"],
-            }
-            if status in ("straggler", "dead", "missing") and status != prev:
-                self.flagged[row["rank"]] = status
-                self._events.append({"event": status, **payload})
-                beat = (
-                    f"last heartbeat {row['age_s']:.1f}s ago"
-                    if isinstance(row["age_s"], float)
-                    else "no heartbeat ever"
-                )
-                print(
-                    f"launch watchdog: rank {row['rank']} is a {status.upper()}"
-                    f" (step {row['step']} vs leader {row['max_step']}, {beat})",
-                    file=self._out or sys.stderr,
-                )
-                if status in ("dead", "missing") and self._on_dead is not None:
-                    # escalation policy: once per transition, AFTER the
-                    # event is durably logged; a policy error must not
-                    # kill the poller (the flagging half keeps working)
-                    try:
-                        self._on_dead(dict(row))
-                    except Exception as e:
-                        print(
-                            f"launch watchdog: on_dead policy failed: {e}",
-                            file=self._out or sys.stderr,
-                        )
-            elif status in ("ok", "finished") and prev in ("straggler", "dead", "missing"):
-                self._events.append({"event": "recovered", **payload})
-                print(
-                    f"launch watchdog: rank {row['rank']} recovered "
-                    f"(step {row['step']})",
-                    file=self._out or sys.stderr,
-                )
-            self._reported[row["rank"]] = status
+        transitions. The WHOLE scan — snapshot read included — holds
+        the poll lock: if only the transition fold were locked, two
+        concurrent polls could apply their snapshots in reversed order
+        and report a stale backwards transition (a recovered rank
+        re-flagged dead, escalating on_dead for a healthy rank)."""
+        with self._poll_lock:
+            # generation-filtered: a relaunched attempt must not
+            # classify (and re-kill) on the PREVIOUS attempt's stale
+            # beats
+            beats = read_heartbeats(
+                self._run_dir, run_id=self._run_id or None, gen=self._gen
+            )
+            t = time.time() if now is None else now
+            # "missing" needs a startup grace: ranks open their
+            # heartbeat streams hundreds of ms apart, and a poll
+            # landing between the first and last start beat must not
+            # flag the slower ranks. A rank is only "missing" once the
+            # run has both produced beats AND outlived the dead
+            # threshold since this watchdog started.
+            expect = (
+                self._n
+                if beats and (t - self._started) > min(self._dead_after, 30.0)
+                else None
+            )
+            rows = classify(
+                beats,
+                t,
+                straggler_factor=self._factor,
+                dead_after_s=self._dead_after,
+                expected_ranks=expect,
+            )
+            for row in rows:
+                status = row["status"]
+                prev = self._reported.get(row["rank"], "ok")
+                # event payload keys deliberately avoid "rank"/"step":
+                # those would collide with the appender's launcher stamp
+                # and the report tool's step-monotonicity gate
+                payload = {
+                    "flagged_rank": row["rank"],
+                    "at_step": row["step"],
+                    "max_step": row["max_step"],
+                    "age_s": row["age_s"],
+                }
+                if status in ("straggler", "dead", "missing") and status != prev:
+                    self.flagged[row["rank"]] = status
+                    self._events.append({"event": status, **payload})
+                    beat = (
+                        f"last heartbeat {row['age_s']:.1f}s ago"
+                        if isinstance(row["age_s"], float)
+                        else "no heartbeat ever"
+                    )
+                    print(
+                        f"launch watchdog: rank {row['rank']} is a {status.upper()}"
+                        f" (step {row['step']} vs leader {row['max_step']}, {beat})",
+                        file=self._out or sys.stderr,
+                    )
+                    if status in ("dead", "missing") and self._on_dead is not None:
+                        # escalation policy: once per transition, AFTER
+                        # the event is durably logged; a policy error
+                        # must not kill the poller (the flagging half
+                        # keeps working)
+                        try:
+                            self._on_dead(dict(row))
+                        except Exception as e:
+                            print(
+                                f"launch watchdog: on_dead policy failed: {e}",
+                                file=self._out or sys.stderr,
+                            )
+                elif status in ("ok", "finished") and prev in ("straggler", "dead", "missing"):
+                    self._events.append({"event": "recovered", **payload})
+                    print(
+                        f"launch watchdog: rank {row['rank']} recovered "
+                        f"(step {row['step']})",
+                        file=self._out or sys.stderr,
+                    )
+                self._reported[row["rank"]] = status
         return rows
 
     def _run(self) -> None:

@@ -27,9 +27,12 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 import numpy as np
+
+if TYPE_CHECKING:  # config type only — no runtime import cycle
+    from xflow_tpu.config import ServeConfig
 
 
 class RejectedRequest(Exception):
@@ -68,7 +71,7 @@ class BrownoutPolicy:
     window_factor: float = 0.25
 
     @staticmethod
-    def from_config(scfg) -> "BrownoutPolicy":
+    def from_config(scfg: "ServeConfig") -> "BrownoutPolicy":
         q = int(scfg.max_queue_rows)
         return BrownoutPolicy(
             high_rows=max(int(q * scfg.brownout_high_frac), 1),
