@@ -1,125 +1,23 @@
-"""Host data-plane scaling harness (VERDICT r2 item 5).
+"""Host data-plane scaling harness (VERDICT r2 item 5): per-core
+parse/plan rates and the 1/2/4-worker thread-scaling curve, printed as
+one JSON line (docs/PERF.md "Host data plane").
 
-The sorted engine's host side must outrun the device: at the round-3
-device rates (FM 1.6M ex/s) one 64k x 18 batch is consumed every
-~41 ms, so parse + plan must sustain >= 1.6M rows/s aggregate. This CI
-image exposes ONE CPU core, so the absolute e2e number here is
-host-bound by construction; this harness records the per-core rates and
-the thread-scaling CURVE (1/2/4 worker caps) for both stages, so the
-claim "a real multi-core TPU host clears the device rate" is backed by
-measured per-core throughput x measured scaling efficiency instead of
-assertion.
+Retired to a thin wrapper: the implementation lives in the unified
+microbench lab (`xflow_tpu/tools/bench_lab.py --suite hostplane`). This
+CLI keeps working, flags unchanged:
 
-  python tools/hostplane_bench.py            # one JSON line
-
-Stages measured:
-- PARSE: the C MT parser pool (xf_mt_*) at 1/2/4 workers over a real
-  libffm file (byte-identical reassembly either way).
-- PLAN: the pair-encoded C radix planner (xf_plan_sorted) on
-  concurrent sub-batch plans (ctypes releases the GIL) at 1/2/4
-  workers.
+    python tools/hostplane_bench.py [--rows N --batch B --nnz F
+                                     --log2-slots S --num-sub K --caps 1,2,4]
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
-import tempfile
-import time
-from concurrent.futures import ThreadPoolExecutor
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-
-
-def bench_parse(path: str, caps, cfg) -> dict:
-    from xflow_tpu.data.pipeline import batch_iterator
-    from xflow_tpu.config import override
-
-    out = {}
-    for cap in caps:
-        c = override(cfg, **{"data.parser_threads": cap})
-        # warm (page cache + pool spin-up)
-        for _ in batch_iterator(path, c.data):
-            pass
-        t0 = time.perf_counter()
-        n = 0
-        for b in batch_iterator(path, c.data):
-            n += b.num_rows
-        dt = time.perf_counter() - t0
-        out[f"parse_rows_per_sec_{cap}w"] = round(n / dt, 1)
-    return out
-
-
-def bench_plan(caps, batch: int, nnz: int, log2_slots: int, num_sub: int) -> dict:
-    from xflow_tpu.data.native import native_plan_sorted
-    from xflow_tpu.ops.sorted_table import WINDOW, padded_len
-
-    S = 1 << log2_slots
-    rng = np.random.default_rng(0)
-    bs = batch // num_sub
-    subs = [
-        np.ascontiguousarray(rng.integers(0, S, (bs, nnz)).astype(np.int32))
-        for _ in range(num_sub)
-    ]
-    mask = np.ones((bs, nnz), np.float32)
-
-    def one(i):
-        return native_plan_sorted(subs[i], mask, None, S, WINDOW, padded_len(bs * nnz))
-
-    out = {}
-    for cap in caps:
-        with ThreadPoolExecutor(max_workers=cap) as pool:
-            list(pool.map(one, range(num_sub)))  # warm
-            t0 = time.perf_counter()
-            reps = 5
-            for _ in range(reps):
-                list(pool.map(one, range(num_sub)))
-            dt = (time.perf_counter() - t0) / reps
-        out[f"plan_rows_per_sec_{cap}w"] = round(batch / dt, 1)
-    return out
-
-
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=500_000)
-    ap.add_argument("--batch", type=int, default=65536)
-    ap.add_argument("--nnz", type=int, default=18)
-    ap.add_argument("--log2-slots", type=int, default=22)
-    ap.add_argument("--num-sub", type=int, default=8,
-                    help="concurrent sub-batch plans (the trainer's "
-                         "parallelism unit)")
-    ap.add_argument("--caps", default="1,2,4")
-    args = ap.parse_args()
-
-    from xflow_tpu.config import Config
-    from xflow_tpu.data.synth import generate_shards_bulk
-
-    caps = [int(c) for c in args.caps.split(",")]
-    record = {"host_cores": os.cpu_count()}
-    with tempfile.TemporaryDirectory() as td:
-        prefix = os.path.join(td, "t")
-        generate_shards_bulk(prefix, 1, args.rows, num_fields=args.nnz,
-                             ids_per_field=200_000, seed=0)
-        from xflow_tpu.config import override
-
-        cfg = override(
-            Config(),
-            **{"data.batch_size": args.batch, "data.max_nnz": args.nnz,
-               "data.log2_slots": args.log2_slots,
-               "model.num_fields": args.nnz},
-        )
-        record.update(bench_parse(prefix + "-00000", caps, cfg))
-    record.update(
-        bench_plan(caps, args.batch, args.nnz, args.log2_slots, args.num_sub)
-    )
-    print(json.dumps(record))
-    return 0
-
+from xflow_tpu.tools.bench_lab import main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main(["--suite", "hostplane"] + sys.argv[1:]))

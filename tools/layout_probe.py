@@ -1,98 +1,23 @@
 """Probe TPU layout/bandwidth for [S, k] vs flat state arrays, and the
-true cost of the table gather/scatter ops.
+true cost of the table gather/scatter ops (carry-threaded methodology:
+each scan iteration depends on the previous one, so loop-invariant
+hoisting and DCE cannot fire — docs/PERF.md "Measurement hygiene").
 
-Methodology: thread the large array through the lax.scan CARRY so each
-iteration depends on the previous one — loop-invariant hoisting and
-dead-code elimination (which silently invalidated a naive `fn(const)`
--in-scan harness) cannot fire. Completion forced by a host scalar read
-(block_until_ready does not sync reliably through the axon tunnel).
+Retired to a thin wrapper: the implementation lives in the unified
+microbench lab (`xflow_tpu/tools/bench_lab.py --suite layout`). This
+CLI keeps working:
+
+    python tools/layout_probe.py
 """
 
-import time
+from __future__ import annotations
 
-import numpy as np
+import os
+import sys
 
-INNER = 4
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def timeit_carry(step, init, iters=6):
-    """step: carry -> carry (same pytree structure). Returns s/iter."""
-    import jax
-
-    @jax.jit
-    def run(c):
-        return jax.lax.scan(lambda c, _: (step(c), None), c, None, length=INNER)[0]
-
-    c = run(init)
-    _ = float(jax.tree.leaves(c)[0].ravel()[0])
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        c = run(c)
-        _ = float(jax.tree.leaves(c)[0].ravel()[0])
-        best = min(best, (time.perf_counter() - t0) / INNER)
-    return best
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    S, K, N = 1 << 22, 11, 1 << 21
-    rng = np.random.default_rng(0)
-    idx = jnp.asarray(rng.integers(0, S, N), jnp.int32)
-    valk = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
-
-    a2d = jnp.full((S, K), 1.0, jnp.float32)
-    aflat = jnp.full((S * K,), 1.0, jnp.float32)
-    apack = jnp.full((S * K // 128, 128), 1.0, jnp.float32)
-
-    r = {}
-    mul = lambda x: x * 1.000001 + 1e-9
-    r["elementwise [4M,11]"] = timeit_carry(mul, a2d)
-    r["elementwise flat 44M"] = timeit_carry(mul, aflat)
-    r["elementwise [344k,128]"] = timeit_carry(mul, apack)
-
-    # gather rows: force each iteration to depend on the previous via a
-    # scalar folded into the indices (cannot be constant-folded)
-    def gather_step(c):
-        t, s = c
-        i = idx + jnp.where(s > 1e30, 1, 0).astype(jnp.int32)
-        g = t[i]
-        return t, s + g.sum()
-
-    r["gather rows [S,11]"] = timeit_carry(gather_step, (a2d, jnp.float32(0)))
-
-    def gather_flat_step(c):
-        t, s = c
-        i = idx + jnp.where(s > 1e30, 1, 0).astype(jnp.int32)
-        g = t.reshape(S, K)[i]
-        return t, s + g.sum()
-
-    r["gather via reshape"] = timeit_carry(gather_flat_step, (aflat, jnp.float32(0)))
-
-    # scatter-add rows: table is the carry — true sequential dependency
-    r["scatter rows [S,11]"] = timeit_carry(lambda t: t.at[idx].add(valk), a2d)
-    r["scatter via reshape"] = timeit_carry(
-        lambda t: t.reshape(S, K).at[idx].add(valk).reshape(S * K), aflat
-    )
-
-    # FTRL-ish update: w,n,z carried, g fixed
-    def ftrl_step(c):
-        w, n, z = c
-        g = valk.sum() * 0 + 1e-4  # scalar, negligible
-        n2 = n + g * g
-        z2 = z + g - (jnp.sqrt(n2) - jnp.sqrt(n)) * 20.0 * w
-        w2 = jnp.where(jnp.abs(z2) <= 5e-5, 0.0, -z2 / ((1.0 + jnp.sqrt(n2)) * 20.0 + 10.0))
-        return w2, n2, z2
-
-    r["ftrl pass [4M,11]x3"] = timeit_carry(ftrl_step, (a2d, a2d * 0.5, a2d * 0.1))
-    r["ftrl pass flat x3"] = timeit_carry(ftrl_step, (aflat, aflat * 0.5, aflat * 0.1))
-
-    print(f"# device={jax.devices()[0]}  (s/iter, carry-threaded)")
-    for k, v in r.items():
-        print(f"{k:24s} {v*1e3:8.2f} ms")
-
+from xflow_tpu.tools.bench_lab import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["--suite", "layout"] + sys.argv[1:]))

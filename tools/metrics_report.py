@@ -105,6 +105,40 @@ SERVE_KEYS = (
 # means a pre-upgrade writer (or a mid-upgrade fleet mixing binaries),
 # not a schema violation — present they ride the all-or-none gate
 OPTIONAL_SERVE_KEYS = ("shed_requests",)
+# the key set every kind="pipeline" window record carries (telemetry
+# .PipelineProfiler.window_record + the trainer's step stamp —
+# docs/OBSERVABILITY.md "Input-pipeline attribution"); --check enforces
+# all-or-none, a positive wall, and the CONCURRENCY invariant: the
+# producer (prefetch thread) and consumer (fit loop) stage groups each
+# sum to at most the window wall — never the two groups combined, they
+# overlap by design
+PIPELINE_KEYS = (
+    "wall_s",
+    "read_s",
+    "parse_s",
+    "hash_s",
+    "batch_s",
+    "pad_s",
+    "plan_s",
+    "producer_wait_s",
+    "queue_wait_s",
+    "transfer_s",
+    "dispatch_s",
+    "device_s",
+    "batches",
+    "rows",
+    "queue_depth",
+    "queue_cap",
+)
+PIPELINE_PRODUCER_SUM = (
+    "read_s", "parse_s", "hash_s", "batch_s", "pad_s", "plan_s",
+    "producer_wait_s",
+)
+PIPELINE_CONSUMER_SUM = ("queue_wait_s", "transfer_s", "dispatch_s", "device_s")
+# slack on the per-thread sum gate: stage accumulations batch on the
+# producer side (a few hundred lines per flush), so a window boundary
+# can carry a sliver of the previous window's time
+PIPELINE_SUM_SLACK = 1.25
 # the key set every kind="span" record carries (xflow_tpu/tracing.py —
 # docs/OBSERVABILITY.md "Request tracing"); `parent` is optional (the
 # root has none), everything else is the assembly contract
@@ -582,6 +616,37 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                     )
                 else:
                     seen_programs[prog_key] = i
+            if kind == "pipeline":
+                pl_missing = [k for k in PIPELINE_KEYS if k not in rec]
+                if pl_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks pipeline keys {pl_missing}"
+                    )
+                elif not _finite(rec["wall_s"]) or rec["wall_s"] <= 0:
+                    problems.append(
+                        f"{tag}: record {i} has non-positive wall_s"
+                    )
+                else:
+                    wall = rec["wall_s"]
+                    for side, keys in (
+                        ("producer", PIPELINE_PRODUCER_SUM),
+                        ("consumer", PIPELINE_CONSUMER_SUM),
+                    ):
+                        vals = [rec[k] for k in keys]
+                        if not all(_finite(v) and v >= 0 for v in vals):
+                            problems.append(
+                                f"{tag}: record {i} has a non-numeric or "
+                                f"negative {side} stage time"
+                            )
+                            continue
+                        ssum = sum(vals)
+                        if ssum > wall * PIPELINE_SUM_SLACK + 0.05:
+                            problems.append(
+                                f"{tag}: record {i} {side}-side stage times "
+                                f"sum {ssum:.3f}s > window wall "
+                                f"{wall:.3f}s — one thread cannot spend "
+                                "more than the wall"
+                            )
             if kind == "span":
                 sp_missing = [k for k in SPAN_KEYS if k not in rec]
                 if sp_missing:
@@ -970,10 +1035,49 @@ def render_health(streams: dict) -> str:
             )
     else:
         lines.append("  heartbeats: none (train.heartbeat_path off?)")
+    pipe_lines = render_pipeline_verdict(streams, newest)
+    if pipe_lines:
+        lines.extend(pipe_lines)
     serve_lines = render_serve_latency_split(streams, newest)
     if serve_lines:
         lines.extend(serve_lines)
     return "\n".join(lines)
+
+
+def render_pipeline_verdict(streams: dict, run_id: str) -> list[str]:
+    """The input-pipeline bottleneck verdict for the --health view
+    (docs/OBSERVABILITY.md "Input-pipeline attribution"), printed next
+    to the queue-wait/device splits: aggregated kind="pipeline" stage
+    seconds + the shared verdict line (telemetry.pipeline_verdict —
+    the same one tools/pipeline_attrib.py prints). Empty when the run
+    carries no pipeline records (train.pipeline_metrics off)."""
+    from xflow_tpu.telemetry import PIPELINE_STAGES, pipeline_verdict
+
+    stages = {s: 0.0 for s in PIPELINE_STAGES}
+    wall = 0.0
+    windows = 0
+    for (rid, _rank, kind, _gen), recs in sorted(streams.items(), key=str):
+        if kind != "pipeline" or rid != run_id:
+            continue
+        for r in recs:
+            if not _finite(r.get("wall_s")):
+                continue
+            windows += 1
+            wall += r["wall_s"]
+            for s in stages:
+                v = r.get(f"{s}_s")
+                if _finite(v):
+                    stages[s] += v
+    if not windows:
+        return []
+    fmt = lambda s: f"{s} {100.0 * stages[s] / wall:.0f}%" if wall > 0 else s
+    return [
+        f"  input pipeline ({windows} window(s)): "
+        + pipeline_verdict(stages, wall),
+        "    stages: "
+        + " | ".join(fmt(s) for s in ("parse", "plan", "producer_wait",
+                                      "queue_wait", "dispatch", "device")),
+    ]
 
 
 def render_serve_latency_split(streams: dict, run_id: str) -> list[str]:

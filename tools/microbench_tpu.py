@@ -1,93 +1,20 @@
 """TPU microbenchmarks for the sparse-table hot ops (docs/PERF.md).
 
-Times each candidate primitive with the lax.scan + host-read-sync
-pattern (block_until_ready does not reliably sync through the axon
-tunnel). Run on the real chip:  python tools/microbench_tpu.py
+Retired to a thin wrapper: the implementation lives in the unified
+microbench lab (`xflow_tpu/tools/bench_lab.py --suite micro`, same
+lax.scan + host-read-sync harness). This CLI keeps working:
+
+    python tools/microbench_tpu.py
 """
 
 from __future__ import annotations
 
-import time
+import os
+import sys
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def timeit(fn, *args, iters=8, inner=4):
-    import jax
-
-    @jax.jit
-    def run(*a):
-        def body(c, _):
-            out = fn(*a)
-            # fold into carry so the loop can't be elided
-            return c + out.ravel()[0].astype(np.float32), None
-
-        c, _ = jax.lax.scan(body, np.float32(0.0), None, length=inner)
-        return c
-
-    r = run(*args)
-    _ = float(r)
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        _ = float(run(*args))
-        best = min(best, (time.perf_counter() - t0) / inner)
-    return best
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    S, N, K = 1 << 22, 1 << 21, 11  # table slots, occurrences, row width
-    rng = np.random.default_rng(0)
-    idx = jnp.asarray(rng.integers(0, S, N), jnp.int32)
-    idx_sorted = jnp.sort(idx)
-    tab1 = jnp.zeros((S,), jnp.float32)
-    tabk = jnp.zeros((S, K), jnp.float32)
-    val1 = jnp.asarray(rng.normal(size=N).astype(np.float32))
-    valk = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
-
-    res = {}
-    res["gather_scalar_2M"] = timeit(lambda t, i: t[i], tab1, idx)
-    res["gather_rows_2M_x11"] = timeit(lambda t, i: t[i], tabk, idx)
-    res["scatter_add_scalar_2M"] = timeit(lambda t, i, v: t.at[i].add(v), tab1, idx, val1)
-    res["scatter_add_rows_2M_x11"] = timeit(lambda t, i, v: t.at[i].add(v), tabk, idx, valk)
-    res["scatter_add_rows_sorted"] = timeit(lambda t, i, v: t.at[i].add(v), tabk, idx_sorted, valk)
-    res["segment_sum_rows_to_table"] = timeit(
-        lambda v, i: jax.ops.segment_sum(v, i, num_segments=S), valk, idx
-    )
-    res["segment_sum_sorted_hint"] = timeit(
-        lambda v, i: jax.ops.segment_sum(v, i, num_segments=S, indices_are_sorted=True),
-        valk,
-        idx_sorted,
-    )
-    res["ftrl_elementwise_3xSxK"] = timeit(
-        lambda w, g: w + g * g, tabk, tabk
-    )
-    # dedup shape: U unique rows + re-gather occurrences from the small array
-    for U_log in (17, 19):
-        U = 1 << U_log
-        uniq = jnp.asarray(rng.integers(0, S, U), jnp.int32)
-        inv = jnp.asarray(rng.integers(0, U, N), jnp.int32)
-        res[f"dedup_gather_U{U>>10}k"] = timeit(
-            lambda t, u, i: t[u][i], tabk, uniq, inv
-        )
-        res[f"dedup_scatter_U{U>>10}k"] = timeit(
-            lambda t, u, i, v: t.at[u].add(
-                jax.ops.segment_sum(v, i, num_segments=U)
-            ),
-            tabk,
-            uniq,
-            inv,
-            valk,
-        )
-
-    dev = jax.devices()[0]
-    print(f"# device={dev}")
-    for k, v in res.items():
-        print(f"{k:32s} {v*1e3:8.2f} ms")
-
+from xflow_tpu.tools.bench_lab import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["--suite", "micro"] + sys.argv[1:]))

@@ -1,149 +1,21 @@
-"""Probe which Pallas/Mosaic DMA slice shapes compile on this TPU.
+"""Probe which Pallas/Mosaic DMA slice shapes compile on this TPU
+(decides the sorted-table kernel data layout, ops/sorted_table.py).
 
-Decides the sorted-table kernel data layout (ops/sorted_table.py):
-Mosaic rejected a [512, 1] slice of an int32 [N, 1] array ("slice shape
-along dimension 1 must be aligned to tiling (128)"). Candidates:
-  A. in_spec BlockSpec (512, 11) over a [S, 11] f32 table
-  B. manual DMA [11, 512] slice of a [11, N] f32 array (dyn col offset)
-  C. manual DMA [1, 512] slice of a [1, N] int32 array (dyn col offset)
-  D. manual DMA [512, 11] slice of an [N, 11] f32 array (dyn row offset)
-Plus: cost of transposing [4M, 11] -> [11, 4M] (needed if only the
-transposed layouts compile).
+Retired to a thin wrapper: the implementation lives in the unified
+microbench lab (`xflow_tpu/tools/bench_lab.py --suite mosaic`). This
+CLI keeps working:
+
+    python tools/mosaic_probe.py
 """
 
-import time
+from __future__ import annotations
 
-import numpy as np
+import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def try_compile(name, fn, *args):
-    import jax
-
-    try:
-        out = jax.jit(fn).lower(*args).compile()
-        print(f"{name}: OK")
-        return True
-    except Exception as e:
-        msg = str(e).split("\n")[0][:140]
-        print(f"{name}: FAIL — {msg}")
-        return False
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    W, C, K = 512, 512, 11
-    S, N = 1 << 14, 1 << 13
-
-    table = jnp.zeros((S, K), jnp.float32)
-    d_t = jnp.zeros((K, N), jnp.float32)
-    sl_row = jnp.zeros((1, N), jnp.int32)
-    d_rows = jnp.zeros((N, K), jnp.float32)
-    off = jnp.zeros((S // W + 1,), jnp.int32)
-
-    # A: BlockSpec windowed table input
-    def kern_a(off_ref, tab_ref, out_ref):
-        out_ref[:, :] = tab_ref[:, :] * 2.0
-
-    def fa(off, table):
-        gs = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(S // W,),
-            in_specs=[pl.BlockSpec((W, K), lambda t, o: (t, 0))],
-            out_specs=pl.BlockSpec((W, K), lambda t, o: (t, 0)),
-        )
-        return pl.pallas_call(kern_a, grid_spec=gs,
-                              out_shape=jax.ShapeDtypeStruct((S, K), jnp.float32))(off, table)
-
-    try_compile("A block (512,11) f32", fa, off, table)
-
-    # B: DMA [K, C] col-slice of [K, N] f32 at dynamic 128-aligned offset
-    def kern_b(off_ref, d_ref, out_ref, scr, sem):
-        t = pl.program_id(0)
-        start = (off_ref[t] // C) * C
-        cp = pltpu.make_async_copy(d_ref.at[:, pl.ds(start, C)], scr, sem)
-        cp.start()
-        cp.wait()
-        out_ref[0, 0] = scr[0, 0]
-
-    def fb(off, d):
-        gs = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(4,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-            scratch_shapes=[pltpu.VMEM((K, C), jnp.float32), pltpu.SemaphoreType.DMA(())],
-        )
-        return pl.pallas_call(kern_b, grid_spec=gs,
-                              out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32))(off, d)
-
-    try_compile("B dma [11,512] of [11,N] f32", fb, off, d_t)
-
-    # C: DMA [1, C] col-slice of [1, N] int32
-    def kern_c(off_ref, s_ref, out_ref, scr, sem):
-        t = pl.program_id(0)
-        start = (off_ref[t] // C) * C
-        cp = pltpu.make_async_copy(s_ref.at[:, pl.ds(start, C)], scr, sem)
-        cp.start()
-        cp.wait()
-        out_ref[0, 0] = scr[0, 0]
-
-    def fc(off, s):
-        gs = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(4,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-            scratch_shapes=[pltpu.VMEM((1, C), jnp.int32), pltpu.SemaphoreType.DMA(())],
-        )
-        return pl.pallas_call(kern_c, grid_spec=gs,
-                              out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32))(off, s)
-
-    try_compile("C dma [1,512] of [1,N] i32", fc, off, sl_row)
-
-    # D: DMA [C, K] row-slice of [N, K] f32 at dynamic unaligned row offset
-    def kern_d(off_ref, d_ref, out_ref, scr, sem):
-        t = pl.program_id(0)
-        start = off_ref[t]
-        cp = pltpu.make_async_copy(d_ref.at[pl.ds(start, C), :], scr, sem)
-        cp.start()
-        cp.wait()
-        out_ref[0, 0] = scr[0, 0]
-
-    def fd(off, d):
-        gs = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(4,),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-            scratch_shapes=[pltpu.VMEM((C, K), jnp.float32), pltpu.SemaphoreType.DMA(())],
-        )
-        return pl.pallas_call(kern_d, grid_spec=gs,
-                              out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32))(off, d)
-
-    try_compile("D dma [512,11] of [N,11] f32 dyn-row", fd, off, d_rows)
-
-    # E: transpose cost [4M, 11] <-> [11, 4M]
-    big = jnp.zeros((1 << 22, K), jnp.float32) + 1.0
-
-    @jax.jit
-    def tr(x, s):
-        y = (x + s).T
-        return y, y[0, 0]
-
-    y, v = tr(big, 0.0)
-    _ = float(v)
-    best = 1e9
-    for i in range(4):
-        t0 = time.perf_counter()
-        y, v = tr(big, float(i))
-        _ = float(v)
-        best = min(best, time.perf_counter() - t0)
-    print(f"E transpose [4M,11]->[11,4M]: {best*1e3:.1f} ms")
-
+from xflow_tpu.tools.bench_lab import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["--suite", "mosaic"] + sys.argv[1:]))

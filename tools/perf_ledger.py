@@ -78,9 +78,12 @@ def _round_of(path: str):
 
 def _lower_is_better(metric, unit) -> bool:
     """Latency-shaped metrics (step_decompose's ms/step slices, serve
-    p50/p99) improve DOWNWARD — 'best' and the regression direction
-    flip relative to throughput."""
-    return str(metric).endswith("_ms") or str(unit).startswith("ms")
+    p50/p99, the lab's ns/element cells) improve DOWNWARD — 'best' and
+    the regression direction flip relative to throughput."""
+    return (
+        str(metric).endswith(("_ms", "_ns", "_ns_per_element"))
+        or str(unit).startswith(("ms", "ns"))
+    )
 
 
 def normalize_bench(path: str, data) -> list[dict]:
@@ -90,6 +93,10 @@ def normalize_bench(path: str, data) -> list[dict]:
     if not isinstance(rec, dict) or "metric" not in rec:
         return []
     rnd = _round_of(path)
+    if rnd is None and _finite(rec.get("round")):
+        # records without a round-numbered filename (BENCH_PIPELINE.json,
+        # pipeline_attrib --round) may stamp the round themselves
+        rnd = int(rec["round"])
     entry = {
         "series": "bench",
         "round": rnd,
@@ -160,6 +167,59 @@ def normalize_scale(path: str, data) -> list[dict]:
     return out
 
 
+def normalize_lab(path: str, data) -> list[dict]:
+    """One BENCH_LAB*.json (xflow_tpu/tools/bench_lab.py --suite core,
+    docs/OBSERVABILITY.md "Sparse-primitive lab") -> ledger entries:
+    the headline gather-latency cell plus one per-cell group
+    (`lab_<op>_s<table_log2>_n<nnz_log2>_<dtype>`, ns/element — the
+    latency direction, gated downward). The round comes from the
+    record's own `round` stamp (operator-chosen) or the filename."""
+    if not isinstance(data, dict) or not isinstance(data.get("cells"), list):
+        return []
+    rnd = data.get("round") if _finite(data.get("round")) else _round_of(path)
+    rnd = int(rnd) if rnd is not None else None
+    out: list[dict] = []
+    if data.get("metric") and _finite(data.get("value")):
+        entry = {
+            "series": "lab",
+            "round": rnd,
+            "path": os.path.basename(path),
+            "metric": data["metric"],
+            "value": data["value"],
+            "unit": data.get("unit", "ns/element"),
+            "headline": True,
+        }
+        if isinstance(data.get("device"), str):
+            entry["device"] = data["device"]
+        if isinstance(data.get("headline_cell"), str):
+            entry["cell"] = data["headline_cell"]
+        out.append(entry)
+    for c in data["cells"]:
+        if not isinstance(c, dict) or not _finite(c.get("ns_per_element")):
+            continue
+        entry = {
+            "series": "lab",
+            "round": rnd,
+            "path": os.path.basename(path),
+            "metric": (
+                f"lab_{c.get('op')}_s{c.get('table_log2')}"
+                f"_n{c.get('nnz_log2')}_{c.get('dtype')}"
+            ),
+            "value": c["ns_per_element"],
+            "unit": "ns/element",
+        }
+        if isinstance(data.get("device"), str):
+            # cells inherit the record's device stamp: the roofline
+            # citation's CPU-vs-chip preference needs it on every entry
+            entry["device"] = data["device"]
+        for key in ("time_ms", "flops", "bytes_accessed", "achieved_gbps",
+                    "compile_time_s", "row_width"):
+            if _finite(c.get(key)):
+                entry[key] = c[key]
+        out.append(entry)
+    return out
+
+
 def normalize_serve(path: str, data) -> list[dict]:
     if not isinstance(data, dict) or "metric" not in data:
         return []
@@ -205,6 +265,10 @@ def collect(root: str, extra: list[str]) -> list[dict]:
             entries.extend(normalize_multichip(path, data))
         elif name == "BENCH_SCALE.json" or "SCALE" in name:
             entries.extend(normalize_scale(path, data))
+        elif name.startswith("BENCH_LAB"):
+            # the sparse-primitive lab matrix (bench_lab --suite core):
+            # per-cell ns/element groups, gated downward
+            entries.extend(normalize_lab(path, data))
         elif name.startswith(("BENCH_SERVE", "BENCH_TRACE")):
             # BENCH_TRACE.json is the serve_bench record measured with
             # request tracing on (tools/smoke_trace.sh): same serve_qps
@@ -214,7 +278,8 @@ def collect(root: str, extra: list[str]) -> list[dict]:
             entries.extend(normalize_bench(path, data))
 
     for pattern in ("BENCH_r*.json", "BENCH_SCALE*.json", "MULTICHIP_r*.json",
-                    "BENCH_SERVE*.json", "BENCH_TRACE*.json"):
+                    "BENCH_SERVE*.json", "BENCH_TRACE*.json",
+                    "BENCH_LAB*.json", "BENCH_PIPELINE*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             add(path)
     for path in extra:
@@ -293,13 +358,16 @@ def roofline(entries: list[dict], hbm_gbps: float) -> dict:
     stamp)."""
     # device-bench headline records (the record's own metric field),
     # newest round; telemetry_* smoke datapoints are CPU numbers with
-    # no roofline meaning and stay out
+    # no roofline meaning and stay out — and so do the pipeline_*
+    # host-gap records (BENCH_PIPELINE.json): their e2e rate is the
+    # HOST-limited number, extrapolating it x64 chips would silently
+    # replace the device headline with the gap it measures
     heads = [
         e for e in entries
         if e["series"] == "bench" and e["round"] is not None
         and e.get("headline") and _finite(e["value"])
         and str(e["metric"]).endswith("_examples_per_sec")
-        and not str(e["metric"]).startswith("telemetry")
+        and not str(e["metric"]).startswith(("telemetry", "pipeline"))
     ]
     if not heads:
         return {}
@@ -328,6 +396,33 @@ def roofline(entries: list[dict], hbm_gbps: float) -> dict:
         out["achieved_pct_of_hbm_bw"] = round(
             100.0 * newest["value"] * bpe / (hbm_gbps * 1e9), 1
         )
+    # the latency citation: the extrapolation's "why the gap" line now
+    # cites the lab's MEASURED gather cell (BENCH_LAB.json) instead of
+    # docs/PERF.md's hand-derived ~11 ns/element figure
+    gathers = [
+        e for e in entries
+        if e["series"] == "lab" and _finite(e["value"])
+        and "gather" in str(e["metric"])
+        and str(e.get("unit", "")).startswith("ns")
+    ]
+    if gathers:
+        # prefer a chip-measured cell over a CPU smoke datapoint: the
+        # citation replaces docs/PERF.md's hand-derived TPU figure, and
+        # a machine-local CPU number must never outrank a chip number
+        # just because it stamped a round
+        pick = max(
+            gathers,
+            key=lambda e: (
+                "cpu" not in str(e.get("device", "")).lower(),
+                bool(e.get("headline")),
+                e["round"] if e["round"] is not None else -1,
+            ),
+        )
+        out["measured_gather_ns_per_element"] = pick["value"]
+        out["gather_cell"] = str(pick.get("cell") or pick["metric"])
+        if isinstance(pick.get("device"), str):
+            out["gather_device"] = pick["device"]
+            out["gather_is_cpu"] = "cpu" in pick["device"].lower()
     return out
 
 
@@ -378,6 +473,27 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
             lines.append(f"| r{_fmt(e['round'])} | {_fmt(e.get('n_devices'))} "
                          f"| {verdict} |")
         lines.append("")
+    lab = groups_of([e for e in entries if e["series"] == "lab"])
+    if lab:
+        lines += ["## Sparse-primitive lab (`BENCH_LAB*.json`)", "",
+                  "| cell | rounds | first | best | newest | GB/s |",
+                  "|---|---|---|---|---|---|"]
+        for (_, metric), group in sorted(lab.items(), key=str):
+            vals = [e for e in group if _finite(e["value"])]
+            if not vals:
+                continue
+            rounds = [e["round"] for e in vals if e["round"] is not None]
+            best = min(vals, key=lambda e: e["value"])  # ns: lower is better
+            newest = vals[-1]
+            lines.append(
+                f"| {metric} | {_fmt(min(rounds)) if rounds else '-'}→"
+                f"{_fmt(max(rounds)) if rounds else '-'} "
+                f"| {_fmt(vals[0]['value'])} "
+                f"| {_fmt(best['value'])} (r{_fmt(best['round'])}) "
+                f"| {_fmt(newest['value'])} "
+                f"| {_fmt(newest.get('achieved_gbps'))} |"
+            )
+        lines.append("")
     scale = [e for e in entries if e["series"] == "scale"]
     if scale:
         lines += ["## Scale run (`BENCH_SCALE.json`, end-to-end)", "",
@@ -425,6 +541,24 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
                 f"modeled bytes/example => the per-chip target is "
                 f"{roof['target_pct_of_hbm_bw']}% of {_fmt(hbm_gbps)} GB/s "
                 f"HBM; this chip achieves {roof['achieved_pct_of_hbm_bw']}%"
+            )
+        if "measured_gather_ns_per_element" in roof:
+            # the trailing claim is honest about WHERE the cell was
+            # measured: a CPU smoke cell tracks the lab's health, only
+            # a chip cell is the latency wall the kernel arc must beat
+            tail = (
+                " — machine-local CPU datapoint; rerun the lab on a "
+                "chip to refresh the latency wall"
+                if roof.get("gather_is_cpu")
+                else " — the latency wall the fused-kernel arc must beat"
+            )
+            lines.append(
+                f"- measured gather random-access latency: "
+                f"{_fmt(roof['measured_gather_ns_per_element'])} ns/element "
+                f"(`{roof['gather_cell']}`, BENCH_LAB"
+                + (f", {roof['gather_device']}" if "gather_device" in roof
+                   else "")
+                + ")" + tail
             )
         lines.append("")
     if len(lines) <= 2:

@@ -1,0 +1,299 @@
+#!/usr/bin/env python3
+"""Input-pipeline attribution report (docs/OBSERVABILITY.md
+"Input-pipeline attribution").
+
+Reads a run's `kind="pipeline"` window records (written by a trainer
+with `train.pipeline_metrics=true`) plus its ordinary metrics stream,
+and answers the question the ROADMAP's ~28x host-side gap raises:
+WHERE does the end-to-end wall time go, stage by stage, and which side
+of the prefetch queue is the bottleneck?
+
+    python tools/pipeline_attrib.py runs/exp1               # table + verdict
+    python tools/pipeline_attrib.py runs/exp1 --json a.json # machine-readable
+    python tools/pipeline_attrib.py runs/exp1 --bench-json BENCH_PIPELINE.json
+
+Two concurrent timelines are reported (the schema's per-thread
+invariant, `metrics_report --check`):
+
+- **consumer** (the fit loop): queue-wait -> transfer -> dispatch ->
+  device. These stages tile the loop, so their sum over the windows is
+  the attribution-coverage figure (the acceptance bar: >= 95% of
+  windowed wall attributed to named stages).
+- **producer** (the prefetch thread): read / parse / hash / batch /
+  pad / plan working time, plus `producer_wait` (blocked in the
+  bounded queue's put — the device-is-the-bottleneck signal).
+
+The verdict line names the binding constraint ("host-bound in parse:
+61% of wall" / "device-bound: producer blocked ...%"), shared with
+`metrics_report --health` (telemetry.pipeline_verdict).
+
+`--bench-json` emits a BENCH-shaped record quantifying the host gap so
+the trajectory (tools/perf_ledger.py) gates it: e2e examples/sec, the
+device-bound rate the run would reach with data-wait removed
+(examples / (elapsed - data_wait_total)), and their ratio — the same
+construction under which BENCH_SCALE.json's 62.5k ex/s e2e vs 1.75M
+device-bound reads as a ~28x gap. This record is the BEFORE
+denominator for the packed-shard-cache PR (ROADMAP "close the loop").
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xflow_tpu.jsonl import read_jsonl_counted  # noqa: E402
+from xflow_tpu.telemetry import (  # noqa: E402
+    PIPELINE_CONSUMER_STAGES,
+    PIPELINE_PRODUCER_STAGES,
+    pipeline_verdict,
+)
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def load_records(paths: list[str]) -> list[dict]:
+    """All records from JSONL files / run dirs, in file order."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            if not found:
+                raise FileNotFoundError(f"{p!r}: directory holds no *.jsonl files")
+            files.extend(found)
+        elif not os.path.exists(p):
+            raise FileNotFoundError(f"{p!r}: no such file")
+        else:
+            files.append(p)
+    records: list[dict] = []
+    for f in files:
+        records.extend(read_jsonl_counted(f)[0])
+    return records
+
+
+def newest_run(records: list[dict]) -> str:
+    """run_id with the largest ts (the run an operator just produced)."""
+    best, best_ts = "?", -1.0
+    seen: dict = {}
+    for r in records:
+        rid = str(r.get("run_id", "?"))
+        ts = r.get("ts", 0.0)
+        if _finite(ts):
+            seen[rid] = max(seen.get(rid, -1.0), ts)
+    for rid, ts in seen.items():
+        if ts > best_ts:
+            best, best_ts = rid, ts
+    return best
+
+
+def attribution(records: list[dict], run_id: str) -> dict:
+    """Aggregate the run's pipeline windows + metrics stream into one
+    attribution summary (empty dict when the run has no kind="pipeline"
+    records — the profiler was off)."""
+    pipe = [
+        r for r in records
+        if r.get("kind") == "pipeline" and str(r.get("run_id", "?")) == run_id
+    ]
+    if not pipe:
+        return {}
+    stages = {s: 0.0 for s in PIPELINE_PRODUCER_STAGES + PIPELINE_CONSUMER_STAGES}
+    wall = 0.0
+    batches = rows = 0
+    for r in pipe:
+        if _finite(r.get("wall_s")):
+            wall += r["wall_s"]
+        for s in stages:
+            v = r.get(f"{s}_s")
+            if _finite(v):
+                stages[s] += v
+        if _finite(r.get("batches")):
+            batches += int(r["batches"])
+        if _finite(r.get("rows")):
+            rows += int(r["rows"])
+    consumer = sum(stages[s] for s in PIPELINE_CONSUMER_STAGES)
+    producer = sum(stages[s] for s in PIPELINE_PRODUCER_STAGES)
+    # the run's own throughput/decomposition context (metrics stream):
+    # cumulative examples, elapsed, and the data-wait run total the
+    # StepTimer's registry counters carry in every counters snapshot
+    mets = [
+        r for r in records
+        if str(r.get("run_id", "?")) == run_id
+        and str(r.get("kind", "metrics")) == "metrics"
+    ]
+    examples = max(
+        (r["examples"] for r in mets if _finite(r.get("examples"))), default=0
+    )
+    elapsed = max(
+        (r["elapsed_s"] for r in mets if _finite(r.get("elapsed_s"))), default=0.0
+    )
+    data_wait = 0.0
+    for r in mets:
+        c = r.get("counters")
+        if isinstance(c, dict) and _finite(c.get("step.data_wait.total_s")):
+            data_wait = max(data_wait, c["step.data_wait.total_s"])
+    out = {
+        "run_id": run_id,
+        "windows": len(pipe),
+        "wall_s": round(wall, 6),
+        "batches": batches,
+        "rows": rows,
+        "stages_s": {s: round(v, 6) for s, v in stages.items()},
+        "consumer_s": round(consumer, 6),
+        "producer_s": round(producer, 6),
+        "attributed_pct": round(100.0 * consumer / wall, 2) if wall > 0 else 0.0,
+        "queue_depth": pipe[-1].get("queue_depth"),
+        "queue_cap": pipe[-1].get("queue_cap"),
+        "verdict": pipeline_verdict(stages, wall),
+        "examples": int(examples),
+        "elapsed_s": round(float(elapsed), 3),
+        "data_wait_s": round(float(data_wait), 6),
+    }
+    if elapsed > 0:
+        e2e = examples / elapsed
+        out["e2e_examples_per_sec"] = round(e2e, 1)
+        busy = elapsed - min(data_wait, elapsed * 0.999)
+        if examples and busy > 0:
+            # the host gap: the rate this run would sustain with the
+            # data-wait removed (everything else unchanged) vs what it
+            # actually sustained — BENCH_SCALE's 62.5k-vs-1.75M ratio
+            # computed from the run's own telemetry
+            out["device_bound_examples_per_sec"] = round(examples / busy, 1)
+            out["host_gap_ratio"] = round((examples / busy) / e2e, 3)
+    return out
+
+
+def bench_record(att: dict, rnd=None) -> dict:
+    """The BENCH-shaped host-gap record (`--bench-json`), consumed by
+    tools/perf_ledger.py: the e2e headline plus the device-bound
+    companion (its `_examples_per_sec` suffix makes it a gated group of
+    its own) and the per-stage budget."""
+    wall = att.get("wall_s") or 0.0
+    rec = {
+        "metric": "pipeline_e2e_examples_per_sec",
+        "value": att.get("e2e_examples_per_sec", 0.0),
+        "unit": "examples/sec",
+        "run_id": att.get("run_id"),
+        "examples": att.get("examples"),
+        "elapsed_s": att.get("elapsed_s"),
+        "data_wait_s": att.get("data_wait_s"),
+        "attributed_pct": att.get("attributed_pct"),
+        "bottleneck": att.get("verdict"),
+        "stage_pct": {
+            s: round(100.0 * v / wall, 2) if wall > 0 else 0.0
+            for s, v in att.get("stages_s", {}).items()
+        },
+    }
+    for key in ("device_bound_examples_per_sec", "host_gap_ratio"):
+        if key in att:
+            rec[key] = att[key]
+    if rnd is not None:
+        rec["round"] = int(rnd)
+    return rec
+
+
+def render(att: dict) -> str:
+    wall = att["wall_s"] or 1e-9
+    lines = [
+        f"pipeline attribution — run {att['run_id']} "
+        f"({att['windows']} window(s), {att['wall_s']:.3f} s wall, "
+        f"{att['rows']} rows / {att['batches']} batches)",
+        f"{'side':9s} {'stage':14s} {'seconds':>10s} {'% of wall':>10s}",
+        f"{'-' * 9} {'-' * 14} {'-' * 10} {'-' * 10}",
+    ]
+    for side, group in (
+        ("consumer", PIPELINE_CONSUMER_STAGES),
+        ("producer", PIPELINE_PRODUCER_STAGES),
+    ):
+        for s in group:
+            v = att["stages_s"].get(s, 0.0)
+            lines.append(
+                f"{side:9s} {s:14s} {v:10.3f} {100.0 * v / wall:9.1f}%"
+            )
+    lines.append(
+        f"attributed (consumer side): {att['attributed_pct']:.1f}% of "
+        "windowed wall"
+    )
+    if "e2e_examples_per_sec" in att:
+        tail = ""
+        if "device_bound_examples_per_sec" in att:
+            tail = (
+                f"  vs device-bound {att['device_bound_examples_per_sec']:,.0f}"
+                f" (host gap {att.get('host_gap_ratio', 1.0):.2f}x)"
+            )
+        lines.append(
+            f"e2e: {att['e2e_examples_per_sec']:,.0f} examples/sec{tail}"
+        )
+    lines.append(f"verdict: {att['verdict']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-stage input-pipeline attribution from a run's "
+        'kind="pipeline" telemetry (train.pipeline_metrics=true)'
+    )
+    ap.add_argument("paths", nargs="+", help="JSONL file(s) and/or run dir(s)")
+    ap.add_argument("--run-id", default="",
+                    help="attribute this run (default: the newest by ts)")
+    ap.add_argument("--json", default="", metavar="OUT",
+                    help="write the attribution summary JSON ('-' = stdout)")
+    ap.add_argument("--bench-json", default="", metavar="OUT",
+                    help="write the BENCH-shaped host-gap record "
+                         "('-' = stdout; feeds tools/perf_ledger.py)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="trajectory round stamped into the bench record "
+                         "(perf_ledger gates rounds)")
+    args = ap.parse_args(argv)
+
+    try:
+        records = load_records(args.paths)
+    except FileNotFoundError as e:
+        print(f"pipeline_attrib: {e}", file=sys.stderr)
+        return 2
+    run_id = args.run_id or newest_run(records)
+    att = attribution(records, run_id)
+    if not att:
+        print(
+            f"pipeline_attrib: run {run_id!r} has no kind=\"pipeline\" "
+            "records — run with train.pipeline_metrics=true",
+            file=sys.stderr,
+        )
+        return 1
+    print(render(att))
+    if args.json:
+        payload = json.dumps(att, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    if args.bench_json:
+        if "e2e_examples_per_sec" not in att:
+            # never feed the trajectory a fabricated 0 ex/s datapoint
+            # (a round-stamped zero would fail --regress against every
+            # real previous round)
+            print(
+                "pipeline_attrib: run has no throughput context "
+                "(metrics stream lacks examples/elapsed — "
+                "train.log_every=0?); refusing to write a bench record",
+                file=sys.stderr,
+            )
+            return 1
+        payload = json.dumps(bench_record(att, rnd=args.round))
+        if args.bench_json == "-":
+            print(payload)
+        else:
+            with open(args.bench_json, "w") as f:
+                f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

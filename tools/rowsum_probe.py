@@ -1,130 +1,21 @@
-"""Probe: can a Pallas scalar-core loop beat XLA's ~43 ms occurrence→row
+"""Probe: can a Pallas scalar-core loop beat XLA's occurrence→row
 scatter (docs/PERF.md "row-reduction kernel" lever)?
 
-The op: accumulate vals [CH, Np] (slot-sorted order, random rows) into
-out [B, CH] by row id. XLA's scatter does ~1 ns/element; the hope is a
-VMEM-resident [B, CH] accumulator + per-occurrence dynamic-sublane
-read-modify-write at a few cycles per occurrence.
+Retired to a thin wrapper: the implementation lives in the unified
+microbench lab (`xflow_tpu/tools/bench_lab.py --suite rowsum`). This
+CLI keeps working:
 
-Measures:
-  A. compile + correctness of dynamic-sublane RMW (acc[r, :] += v)
-  B. throughput vs the XLA segment-sum at bench shapes
+    python tools/rowsum_probe.py
 """
 
+from __future__ import annotations
+
+import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def main():
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    B = 65536
-    CH = 24  # padded channel count (21 used)
-    C = 512  # chunk
-    Np = 2098176  # padded_len(65536*32)
-    K = 4  # batches in the scan
-
-    rng = np.random.default_rng(0)
-    rows = rng.integers(0, B, (K, Np)).astype(np.int32)
-    vals = rng.normal(size=(K, CH, Np)).astype(np.float32)
-
-    n_chunks = Np // C
-
-    def kernel(rows_ref, vals_ref, out_ref, acc2, vchunk, vt_ref, rchunk, sem_v, sem_r):
-        out_ref[:, :] = jnp.zeros((B, CH), jnp.float32)
-        acc2[:, :] = jnp.zeros((B, CH), jnp.float32)
-
-        def chunk_step(c, carry):
-            o = c * C
-            cp_r = pltpu.make_async_copy(rows_ref.at[:, pl.ds(o, C)], rchunk, sem_r)
-            cp_r.start()
-            cp_v = pltpu.make_async_copy(vals_ref.at[:, pl.ds(o, C)], vchunk, sem_v)
-            cp_v.start()
-            cp_r.wait()
-            cp_v.wait()
-            vt_ref[:, :] = vchunk[:, :].T  # [C, CH] staged for row reads
-
-            def inner(i, carry2):
-                r0 = rchunk[0, 2 * i]
-                r1 = rchunk[0, 2 * i + 1]
-                out_ref[pl.ds(r0, 1), :] += vt_ref[pl.ds(2 * i, 1), :]
-                acc2[pl.ds(r1, 1), :] += vt_ref[pl.ds(2 * i + 1, 1), :]
-                return carry2
-
-            jax.lax.fori_loop(0, C // 2, inner, 0)
-            return carry
-
-        jax.lax.fori_loop(0, n_chunks, chunk_step, 0)
-        out_ref[:, :] += acc2[:, :]
-
-    def rowsum_pallas(rows1, vals1):
-        return pl.pallas_call(
-            kernel,
-            grid=(1,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=pl.BlockSpec((B, CH), lambda i: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((B, CH), jnp.float32),
-            scratch_shapes=[
-                pltpu.VMEM((B, CH), jnp.float32),
-                pltpu.VMEM((CH, C), jnp.float32),
-                pltpu.VMEM((C, CH), jnp.float32),
-                pltpu.SMEM((1, C), jnp.int32),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-            ],
-        )(rows1.reshape(1, Np), vals1)
-
-    # correctness on a small case first (interpret on CPU would be slow;
-    # run tiny on device)
-    try:
-        jit_rowsum = jax.jit(rowsum_pallas)
-        small_out = jit_rowsum(jnp.asarray(rows[0]), jnp.asarray(vals[0]))
-        got = np.asarray(small_out)
-    except Exception as e:
-        print(f"COMPILE/RUN FAIL: {str(e).splitlines()[0][:300]}")
-        return 1
-    want = np.zeros((B, CH), np.float32)
-    np.add.at(want, rows[0], vals[0].T)
-    err = np.abs(got - want).max()
-    print(f"correctness: max abs err = {err:.2e}")
-
-    @jax.jit
-    def run_pallas(rows, vals):
-        def body(c, b):
-            out = rowsum_pallas(b[0], b[1])
-            return c + out[::97, 0].sum() + out[::89, 5].sum(), None
-
-        return jax.lax.scan(body, 0.0, (rows, vals))[0]
-
-    @jax.jit
-    def run_xla(rows, vals):
-        def body(c, b):
-            out = jax.ops.segment_sum(b[1].T, b[0], num_segments=B)
-            return c + out[::97, 0].sum() + out[::89, 5].sum(), None
-
-        return jax.lax.scan(body, 0.0, (rows, vals))[0]
-
-    jrows, jvals = jnp.asarray(rows), jnp.asarray(vals)
-    for name, fn in [("pallas scalar-RMW", run_pallas), ("xla segment_sum", run_xla)]:
-        out = fn(jrows, jvals)
-        _ = float(out)
-        best = 1e9
-        for _ in range(3):
-            t0 = time.perf_counter()
-            out = fn(jrows, jvals)
-            _ = float(out)
-            best = min(best, (time.perf_counter() - t0) / K)
-        print(f"{name}: {best*1e3:.1f} ms ({best/Np*1e9:.2f} ns/occurrence)")
-    return 0
-
+from xflow_tpu.tools.bench_lab import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--suite", "rowsum"] + sys.argv[1:]))

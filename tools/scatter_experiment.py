@@ -1,137 +1,24 @@
-"""Validate the sorted windowed-matmul scatter design (docs/PERF.md lever).
+"""Validate the sorted windowed-matmul scatter design (docs/PERF.md
+lever): permute gradient rows into slot-sorted order, scan over
+fixed-size chunks doing a one-hot matmul against a W-aligned table
+window, and check numerical equality vs the XLA scatter.
 
-The FM/MVM backward is dominated by the XLA scatter-add of [2M, 11]
-gradient rows into the [4M, 11] table (~216 ms measured). Candidate
-replacement: permute gradient rows into slot-sorted order (one gather),
-then scan over fixed-size chunks doing a one-hot matmul against a
-W-aligned table window and a dynamic_update_slice accumulate.
+Retired to a thin wrapper: the implementation (including the
+`host_sort_plan` chunk planner) lives in the unified microbench lab
+(`xflow_tpu/tools/bench_lab.py --suite scatter`). This CLI keeps
+working:
 
-Measures: permute gather, the scan pipeline, end-to-end, and checks
-numerical equality vs the XLA scatter.
+    python tools/scatter_experiment.py
 """
 
-import time
+from __future__ import annotations
 
-import numpy as np
+import os
+import sys
 
-C = 1024  # occurrences per chunk
-W = 2048  # table window (slot-grid aligned)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def host_sort_plan(slots_flat: np.ndarray, S: int):
-    """(perm [M], sorted_slots [M], bases [M//C]) — chunks grid-aligned.
-
-    perm maps sorted position -> occurrence index (N = dummy zero row).
-    """
-    N = slots_flat.shape[0]
-    order = np.argsort(slots_flat, kind="stable")
-    ss = slots_flat[order]
-    win = ss // W
-    # chunk boundaries: every C occurrences, or window change
-    M_cap = N + (S // W + 1) * C
-    perm = np.full(M_cap, N, np.int32)
-    srt = np.zeros(M_cap, np.int32)
-    bases = []
-    pos = 0
-    i = 0
-    while i < N:
-        w = win[i]
-        j = min(N, i + C)
-        # shrink to this window only
-        j = i + int(np.searchsorted(win[i:j], w + 1))
-        take = j - i
-        perm[pos : pos + take] = order[i:j]
-        srt[pos : pos + take] = ss[i:j]
-        srt[pos + take : pos + C] = w * W  # dummies point in-window
-        bases.append(w * W)
-        pos += C
-        i = j
-    nchunks = len(bases)
-    return (
-        perm[: nchunks * C],
-        srt[: nchunks * C],
-        np.asarray(bases, np.int32),
-    )
-
-
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    S, N, K = 1 << 22, 1 << 21, 11
-    rng = np.random.default_rng(0)
-    slots = rng.integers(0, S, N).astype(np.int32)
-    d_occ = rng.normal(size=(N, K)).astype(np.float32)
-
-    t0 = time.perf_counter()
-    perm, srt, bases = host_sort_plan(slots, S)
-    t_host = time.perf_counter() - t0
-    nchunks = len(bases)
-    print(f"host plan: {t_host*1e3:.1f} ms, nchunks={nchunks} (pad {nchunks*C/N:.3f}x)")
-
-    jperm = jnp.asarray(perm)
-    jsrt = jnp.asarray(srt.reshape(nchunks, C))
-    jbases = jnp.asarray(bases)
-    jd = jnp.asarray(d_occ)
-    jslots = jnp.asarray(slots)
-
-    def timeit(f, *a, iters=5):
-        out = f(*a)
-        _ = float(jax.tree.leaves(out)[0].ravel()[0])
-        best = float("inf")
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            out = f(*a)
-            _ = float(jax.tree.leaves(out)[0].ravel()[0])
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    # 1. permute gather: [M,K] from compact [N+1,K]
-    @jax.jit
-    def permute(d, p):
-        dpad = jnp.concatenate([d, jnp.zeros((1, K), d.dtype)], 0)
-        return dpad[p]
-
-    t = timeit(permute, jd, jperm)
-    print(f"permute gather [{len(perm)},{K}]: {t*1e3:7.1f} ms")
-
-    # 2. windowed matmul scatter via scan
-    @jax.jit
-    def windowed_scatter(d, p, srt2d, bases1d):
-        dpad = jnp.concatenate([d, jnp.zeros((1, K), d.dtype)], 0)
-        ds = dpad[p].reshape(nchunks, C, K)
-
-        def body(tab, xs):
-            dch, sch, base = xs
-            onehot = (sch[:, None] == base + jax.lax.broadcasted_iota(jnp.int32, (C, W), 1)).astype(
-                jnp.float32
-            )
-            upd = jax.lax.dot_general(
-                onehot, dch, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            )  # [W, K]
-            win = jax.lax.dynamic_slice(tab, (base, 0), (W, K))
-            return jax.lax.dynamic_update_slice(tab, win + upd, (base, 0)), None
-
-        tab = jnp.zeros((S, K), jnp.float32)
-        tab, _ = jax.lax.scan(body, tab, (ds, srt2d, bases1d))
-        return tab
-
-    t = timeit(windowed_scatter, jd, jperm, jsrt, jbases)
-    print(f"windowed scatter e2e   : {t*1e3:7.1f} ms")
-
-    # 3. XLA scatter baseline + equality
-    @jax.jit
-    def xla_scatter(d, s):
-        return jnp.zeros((S, K), jnp.float32).at[s].add(d)
-
-    t = timeit(xla_scatter, jd, jslots)
-    print(f"xla scatter-add        : {t*1e3:7.1f} ms")
-
-    a = np.asarray(windowed_scatter(jd, jperm, jsrt, jbases))
-    b = np.asarray(xla_scatter(jd, jslots))
-    err = np.max(np.abs(a - b))
-    print(f"max |windowed - xla|   : {err:.3e}")
-
+from xflow_tpu.tools.bench_lab import host_sort_plan, main  # noqa: E402,F401
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main(["--suite", "scatter"] + sys.argv[1:]))

@@ -1,0 +1,162 @@
+#!/usr/bin/env bash
+# Hot-path attribution smoke gate (docs/OBSERVABILITY.md
+# "Input-pipeline attribution" / "Sparse-primitive lab"): one profiled
+# synthetic CPU train + one CPU-sized lab sweep proving the whole
+# attribution layer end to end —
+#   1. a train.pipeline_metrics=true run emits kind="pipeline" windows
+#      that pass metrics_report --check (all-or-none keys, the
+#      per-thread sum invariant) and surface in --health's verdict;
+#   2. tools/pipeline_attrib.py attributes >= 95% of the windowed wall
+#      to named stages, prints the bottleneck verdict, and emits the
+#      BENCH-shaped host-gap record (BENCH_PIPELINE.json);
+#   3. a profiler-OFF run carries ZERO pipeline records and no
+#      pipeline.* counters (the zero-overhead-when-off contract);
+#   4. a small bench_lab --suite core sweep emits BENCH_LAB.json with a
+#      gather x {table size, nnz} matrix and CompileRecorder cost
+#      stamps;
+#   5. both records land in the tools/perf_ledger.py trajectory (lab
+#      section rendered, measured gather latency cited in the roofline
+#      block), and the ledger's regression mode exits 3 on a controlled
+#      regressed lab corpus.
+#
+# Standalone:    bash tools/smoke_hotpath.sh [workdir]
+# From pytest:   tests/test_hotpath.py::test_smoke_hotpath_script
+#
+# With no workdir argument a temp dir is created and cleaned up.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# datapoint destinations: the repo root ONLY standalone (the committed
+# trajectory records); pytest runs keep them in the workdir so test
+# runs never rewrite the committed files with machine-local numbers.
+# ROUND stamps the records (this PR's round number, like smoke_perf's
+# BENCH_r09 filename) — without it perf_ledger --regress would skip
+# the lab/pipeline groups forever (gating needs >= 2 numbered rounds)
+ROUND=11
+PIPE_OUT="$ROOT/BENCH_PIPELINE.json"
+LAB_OUT="$ROOT/BENCH_LAB.json"
+if [ -z "$WORK" ]; then
+    WORK="$(mktemp -d)"
+    trap 'rm -rf "$WORK"' EXIT
+else
+    PIPE_OUT="$WORK/BENCH_PIPELINE.json"
+    LAB_OUT="$WORK/BENCH_LAB.json"
+fi
+
+export JAX_PLATFORMS=cpu
+
+# ---- 1. profiled run: pipeline windows + schema/health gates --------------
+# 3200 rows / batch 64 = 50 steps, log_every=10 -> ~5 windows + tail
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+
+python -m xflow_tpu train \
+    --train "$WORK/train" --model lr --epochs 1 \
+    --batch-size 64 --log2-slots 12 --no-mesh \
+    --set model.num_fields=6 \
+    --set data.max_nnz=8 \
+    --set train.pred_dump=false \
+    --set train.log_every=10 \
+    --set train.pipeline_metrics=true \
+    --set "train.metrics_path=$WORK/run/metrics_rank0.jsonl" \
+    >/dev/null
+
+python tools/metrics_report.py "$WORK/run" --check
+# capture-then-grep: a `| grep -q` pipe would SIGPIPE the producer
+# under pipefail the moment grep matches and exits
+python tools/metrics_report.py "$WORK/run" --health > "$WORK/health.txt"
+grep -q "input pipeline" "$WORK/health.txt"
+
+# ---- 2. attribution report: coverage + verdict + host-gap record ----------
+python tools/pipeline_attrib.py "$WORK/run" \
+    --json "$WORK/attrib.json" --bench-json "$PIPE_OUT" --round "$ROUND"
+python - "$WORK/attrib.json" <<'EOF'
+import json, sys
+a = json.load(open(sys.argv[1]))
+assert a["windows"] >= 2, f"too few pipeline windows: {a['windows']}"
+assert a["attributed_pct"] >= 95.0, \
+    f"only {a['attributed_pct']}% of wall attributed to named stages"
+assert a["verdict"], "no bottleneck verdict"
+assert a.get("e2e_examples_per_sec", 0) > 0, "no e2e throughput"
+assert a.get("host_gap_ratio", 0) > 0, "no host-gap ratio"
+print(f"smoke_hotpath: {a['attributed_pct']}% attributed; "
+      f"verdict: {a['verdict']}")
+EOF
+
+# ---- 3. zero-overhead-when-off: no pipeline records in an OFF run ---------
+python -m xflow_tpu train \
+    --train "$WORK/train" --model lr --epochs 1 \
+    --batch-size 64 --log2-slots 12 --no-mesh \
+    --set model.num_fields=6 \
+    --set data.max_nnz=8 \
+    --set train.pred_dump=false \
+    --set train.log_every=10 \
+    --set "train.metrics_path=$WORK/run_off/metrics_rank0.jsonl" \
+    >/dev/null
+python tools/metrics_report.py "$WORK/run_off" --check
+python - "$WORK/run_off/metrics_rank0.jsonl" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+pipe = [r for r in recs if r.get("kind") == "pipeline"]
+assert not pipe, f"profiler-off run emitted {len(pipe)} pipeline record(s)"
+leaked = [
+    k for r in recs for k in (r.get("counters") or {})
+    if k.startswith("pipeline.")
+]
+assert not leaked, f"profiler-off run leaked pipeline counters: {leaked}"
+print("smoke_hotpath: profiler-off stream is pipeline-free")
+EOF
+
+# ---- 4. CPU-sized lab sweep: the gather x {table, nnz} baseline matrix ----
+python -m xflow_tpu.tools.bench_lab --suite core \
+    --table-log2 10,12 --nnz-log2 8,9 --row-width 4 \
+    --iters 2 --inner 2 --round "$ROUND" --out "$LAB_OUT" 2>/dev/null
+python - "$LAB_OUT" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d["kind"] == "bench_lab" and d["unit"] == "ns/element"
+gathers = {(c["table_log2"], c["nnz_log2"])
+           for c in d["cells"] if c["op"] == "gather"}
+assert len(gathers) >= 4, f"gather sweep too small: {gathers}"
+assert all(c["ns_per_element"] > 0 for c in d["cells"])
+assert any(c.get("bytes_accessed") for c in d["cells"]), \
+    "no CompileRecorder cost stamps in any cell"
+print(f"smoke_hotpath: lab swept {len(d['cells'])} cell(s), "
+      f"headline {d['metric']}={d['value']} ns/element")
+EOF
+
+# ---- 5. both records through the ledger + regression mechanics ------------
+python tools/perf_ledger.py "$PIPE_OUT" "$LAB_OUT" \
+    --markdown "$WORK/ledger.md" --json "$WORK/ledger.json"
+grep -q "Sparse-primitive lab" "$WORK/ledger.md"
+grep -q "pipeline_e2e_examples_per_sec" "$WORK/ledger.md"
+grep -q "measured gather random-access latency" "$WORK/ledger.md"
+
+# regression mechanics: a second lab round whose gather cell got SLOWER
+# must exit 3 (ns/element gates downward)
+mkdir -p "$WORK/series"
+python - "$LAB_OUT" "$WORK/series" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+d["round"] = 1
+json.dump(d, open(sys.argv[2] + "/BENCH_LAB_r01.json", "w"))
+d = json.loads(json.dumps(d))
+d["round"] = 2
+d["value"] = d["value"] * 10.0
+for c in d["cells"]:
+    c["ns_per_element"] = c["ns_per_element"] * 10.0
+json.dump(d, open(sys.argv[2] + "/BENCH_LAB_r02.json", "w"))
+EOF
+rc=0
+python tools/perf_ledger.py --root "$WORK/series" --regress --markdown '' \
+    >/dev/null 2>&1 || rc=$?
+[ "$rc" -eq 3 ] || {
+    echo "smoke_hotpath: lab regression expected exit 3, got $rc"; exit 1; }
+
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
+echo "smoke_hotpath: OK"

@@ -355,6 +355,17 @@ class TrainConfig:
     # stall (faulthandler), then re-arm when progress resumes. SIGUSR1
     # stack dumps are always installed during fit() (main thread only).
     hang_timeout_s: float = 0.0
+    # input-pipeline stage profiler (docs/OBSERVABILITY.md
+    # "Input-pipeline attribution"): attribute wall time per pipeline
+    # stage — read/parse/hash/batch/pad/plan on the prefetch thread,
+    # queue-wait/transfer/dispatch/device on the fit loop, plus the
+    # prefetch queue's depth and producer-blocked gauges — into
+    # kind="pipeline" window records in the metrics JSONL, read by
+    # tools/pipeline_attrib.py (per-stage % table, bottleneck verdict,
+    # host-gap bench record). Default off: the instrumented seams take
+    # their exact pre-profiler code paths and the JSONL streams are
+    # byte-identical to a build without the profiler (pinned by test).
+    pipeline_metrics: bool = False
     # compile accounting (docs/OBSERVABILITY.md "Compile accounting"):
     # every step/predict compilation routes through a shared
     # telemetry.CompileRecorder — explicit .lower().compile() with the

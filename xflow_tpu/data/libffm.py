@@ -85,10 +85,59 @@ def shard_path(prefix: str, rank: int) -> str:
     return "%s-%05d" % (prefix, rank)
 
 
+def split_line(
+    line: str,
+) -> Optional[tuple[float, list[int], list[str]]]:
+    """The PARSE half of `parse_line`: label + token split, feature id
+    strings still unhashed → (label, fields, feature-id strings).
+
+    Split out so the pipeline profiler (telemetry.PipelineProfiler) can
+    attribute parse and hash time separately. NOTE: `parse_line` does
+    NOT compose these halves — it keeps its own fused single-pass loop
+    so the un-profiled hot path pays nothing for the split — so any
+    token-rule change must be made in BOTH places; the parity is pinned
+    by tests/test_hotpath.py::test_parse_line_matches_profiled_halves
+    and the counter/parser parity suite."""
+    line = line.strip(_ASCII_WS)
+    if not line:
+        return None
+    parts = line.split("\t", 1)
+    if len(parts) == 1:
+        # tolerate space-separated label too
+        parts = line.split(" ", 1)
+        if len(parts) == 1:
+            return None
+    label = 1.0 if _strtod(parts[0]) > 1e-7 else 0.0
+    fields: list[int] = []
+    ids: list[str] = []
+    for tok in _TOKEN_SEP.split(parts[1]):
+        pieces = tok.split(":")
+        if len(pieces) < 2:
+            continue
+        fields.append(_fgid_i32(_strtod(pieces[0])))
+        ids.append(pieces[1])
+    return label, fields, ids
+
+
+def hash_ids(ids: list[str], log2_slots: int, salt: int = 0) -> np.ndarray:
+    """The HASH half: feature-id strings → folded slot ids (int32)."""
+    return np.asarray(
+        [slot_of(fnv1a64(t.encode("utf-8"), salt), log2_slots) for t in ids],
+        dtype=np.int32,
+    )
+
+
 def parse_line(
     line: str, log2_slots: int, salt: int = 0
 ) -> Optional[tuple[float, np.ndarray, np.ndarray]]:
-    """Parse one libffm line → (label, fields[int32], slots[int32])."""
+    """Parse one libffm line → (label, fields[int32], slots[int32]).
+
+    Deliberately the FUSED single-pass loop (hash inline, no
+    intermediate id-string list) — this is the Python fallback parser's
+    hot path, and the profiled split through `split_line` + `hash_ids`
+    must cost the un-profiled path nothing. The three functions share
+    the token rules; parity is pinned by tests/test_libffm.py and the
+    counter/parser parity suite."""
     line = line.strip(_ASCII_WS)
     if not line:
         return None
@@ -115,14 +164,60 @@ def parse_line(
 
 
 def iter_examples(
-    path: str, log2_slots: int, salt: int = 0
+    path: str, log2_slots: int, salt: int = 0, profiler=None
 ) -> Iterator[tuple[float, np.ndarray, np.ndarray]]:
-    """Stream (label, fields, slots) examples from a libffm file."""
+    """Stream (label, fields, slots) examples from a libffm file.
+
+    `profiler` (telemetry.PipelineProfiler, optional) attributes wall
+    time to the read / parse / hash stages; the per-line accumulations
+    batch locally and flush to the (locked) profiler every few hundred
+    lines so attribution never contends per row. None = the exact
+    historical loop."""
+    if profiler is not None:
+        yield from _profiled_iter_examples(path, log2_slots, salt, profiler)
+        return
     with open(path, "r") as f:
         for line in f:
             ex = parse_line(line, log2_slots, salt)
             if ex is not None:
                 yield ex
+
+
+def _profiled_iter_examples(
+    path: str, log2_slots: int, salt: int, profiler
+) -> Iterator[tuple[float, np.ndarray, np.ndarray]]:
+    import time
+
+    pc = time.perf_counter
+    acc = {"read": 0.0, "parse": 0.0, "hash": 0.0}
+    pending = 0
+    try:
+        with open(path, "r") as f:
+            while True:
+                t0 = pc()
+                line = f.readline()
+                acc["read"] += pc() - t0
+                if not line:
+                    return
+                t0 = pc()
+                t = split_line(line)
+                acc["parse"] += pc() - t0
+                if t is None:
+                    continue
+                label, fields, ids = t
+                t0 = pc()
+                slots = hash_ids(ids, log2_slots, salt)
+                acc["hash"] += pc() - t0
+                pending += 1
+                if pending >= 512:
+                    profiler.add_many(acc)
+                    acc = {"read": 0.0, "parse": 0.0, "hash": 0.0}
+                    pending = 0
+                yield label, np.asarray(fields, dtype=np.int32), slots
+    finally:
+        # flush the tail (and the abandonment path: prefetch's close()
+        # cascade raises GeneratorExit through the yield above)
+        profiler.add_many(acc)
 
 
 def read_examples(

@@ -17,6 +17,7 @@ from __future__ import annotations
 import queue
 import subprocess
 import threading
+import time
 from typing import Iterable, Iterator, Optional
 
 import sys
@@ -123,7 +124,13 @@ def examples_to_batches(
     batch_size: int,
     max_nnz: int,
     drop_remainder: bool = False,
+    profiler=None,
 ) -> Iterator[SparseBatch]:
+    if profiler is not None:
+        yield from _profiled_examples_to_batches(
+            examples, batch_size, max_nnz, drop_remainder, profiler
+        )
+        return
     labels: list[float] = []
     fields: list[np.ndarray] = []
     slots: list[np.ndarray] = []
@@ -136,6 +143,43 @@ def examples_to_batches(
             labels, fields, slots = [], [], []
     if labels and not drop_remainder:
         yield make_batch(fields, slots, labels, batch_size, max_nnz)
+
+
+def _profiled_examples_to_batches(
+    examples, batch_size: int, max_nnz: int, drop_remainder: bool, profiler
+) -> Iterator[SparseBatch]:
+    """`examples_to_batches` with the batch-assembly ("batch": the
+    per-example row accumulation) and padding ("pad": make_batch's
+    padded-array fill) stages attributed (telemetry.PipelineProfiler).
+    The pull of each example from the iterator is NOT timed here — that
+    wall belongs to the upstream read/parse/hash stages."""
+    pc = time.perf_counter
+    labels: list[float] = []
+    fields: list[np.ndarray] = []
+    slots: list[np.ndarray] = []
+    acc = 0.0
+    for label, f, s in examples:
+        t0 = pc()
+        labels.append(label)
+        fields.append(f)
+        slots.append(s)
+        acc += pc() - t0
+        if len(labels) == batch_size:
+            t0 = pc()
+            b = make_batch(fields, slots, labels, batch_size, max_nnz)
+            profiler.add("pad", pc() - t0)
+            profiler.add("batch", acc)
+            acc = 0.0
+            profiler.count_batch(b.num_rows)
+            labels, fields, slots = [], [], []
+            yield b
+    profiler.add("batch", acc)
+    if labels and not drop_remainder:
+        t0 = pc()
+        b = make_batch(fields, slots, labels, batch_size, max_nnz)
+        profiler.add("pad", pc() - t0)
+        profiler.count_batch(b.num_rows)
+        yield b
 
 
 def assign_shards(
@@ -192,6 +236,7 @@ def batch_iterator(
     enforce_bad_rows: bool = True,
     quarantine: bool = True,
     skip: int = 0,
+    profiler=None,
 ) -> Iterator[SparseBatch]:
     """Stream padded batches from a libffm file, preferring the native
     parser. Every batch passes through the bad-record monitor
@@ -202,8 +247,10 @@ def batch_iterator(
     model's predict pass). `skip` fast-forwards the stream past its
     first `skip` batches (checkpointed data_state resume,
     `skip_batches`) — skipped batches are neither monitored nor
-    quarantined; they were already, in the run being resumed."""
-    raw = _raw_batch_iterator(path, cfg, batch_size)
+    quarantined; they were already, in the run being resumed.
+    `profiler` (telemetry.PipelineProfiler) attributes per-stage wall
+    time; None = the exact historical path."""
+    raw = _raw_batch_iterator(path, cfg, batch_size, profiler=profiler)
     if skip > 0:
         raw = skip_batches(raw, skip)
     yield from monitor_bad_rows(
@@ -216,6 +263,7 @@ def _raw_batch_iterator(
     path: str,
     cfg: DataConfig,
     batch_size: Optional[int] = None,
+    profiler=None,
 ) -> Iterator[SparseBatch]:
     bs = batch_size or cfg.batch_size
     if cfg.use_native_parser:
@@ -232,13 +280,27 @@ def _raw_batch_iterator(
         except (ImportError, OSError, RuntimeError, subprocess.SubprocessError):
             native_iter = None
         if native_iter is not None:
-            yield from native_iter
-            return
+            if profiler is None:
+                yield from native_iter
+                return
+            # the C parser does read+parse+hash+assembly+pad inside one
+            # next_batch call — attributed as "parse", the honest
+            # resolution this path offers (docs/OBSERVABILITY.md)
+            pc = time.perf_counter
+            while True:
+                t0 = pc()
+                b = next(native_iter, None)
+                profiler.add("parse", pc() - t0)
+                if b is None:
+                    return
+                profiler.count_batch(b.num_rows)
+                yield b
     yield from examples_to_batches(
-        iter_examples(path, cfg.log2_slots, cfg.hash_salt),
+        iter_examples(path, cfg.log2_slots, cfg.hash_salt, profiler=profiler),
         bs,
         cfg.max_nnz,
         cfg.drop_remainder,
+        profiler=profiler,
     )
 
 
@@ -268,7 +330,9 @@ def count_batches(path: str, cfg: DataConfig, batch_size: Optional[int] = None) 
     return rows // bs if cfg.drop_remainder else -(-rows // bs)
 
 
-def prefetch(iterator: Iterator[SparseBatch], depth: int = 2) -> Iterator[SparseBatch]:
+def prefetch(
+    iterator: Iterator[SparseBatch], depth: int = 2, profiler=None
+) -> Iterator[SparseBatch]:
     """Run the parse/batch pipeline in a background thread with a bounded queue.
 
     Abandonment-safe: when the consumer drops the generator mid-epoch
@@ -278,15 +342,32 @@ def prefetch(iterator: Iterator[SparseBatch], depth: int = 2) -> Iterator[Sparse
     underlying iterator (releasing native parser handles / quarantine
     files promptly), and exits — previously it blocked on `q.put`
     forever, leaking one thread (and pinning its batch buffers) per
-    abandoned epoch."""
+    abandoned epoch.
+
+    `profiler` (telemetry.PipelineProfiler) exposes the queue's
+    counters: time the WORKER spends blocked in `q.put` is
+    `producer_wait` (the consumer/device is the bottleneck —
+    cumulative in the `pipeline.producer_blocked_s` gauge), and both
+    sides sample `q.qsize()` into the `pipeline.queue_depth` gauge.
+    The CONSUMER-side starvation signal (`queue_wait`) is attributed by
+    the fit loop as the batch's full data-wait — not here — so the
+    consumer stages tile the loop with nothing counted twice. None =
+    the exact historical path."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
     stop = threading.Event()
 
     def worker() -> None:
+        pc = time.perf_counter
         try:
             for item in iterator:
-                q.put(item)
+                if profiler is None:
+                    q.put(item)
+                else:
+                    t0 = pc()
+                    q.put(item)
+                    profiler.add("producer_wait", pc() - t0)
+                    profiler.observe_queue(q.qsize(), depth)
                 if stop.is_set():
                     return
             q.put(_END)
@@ -303,6 +384,8 @@ def prefetch(iterator: Iterator[SparseBatch], depth: int = 2) -> Iterator[Sparse
     try:
         while True:
             item = q.get()
+            if profiler is not None:
+                profiler.observe_queue(q.qsize(), depth)
             if item is _END:
                 break
             if isinstance(item, BaseException):

@@ -34,6 +34,7 @@ from xflow_tpu.models import get_model
 from xflow_tpu.telemetry import (
     HangWatchdog,
     HealthMonitor,
+    PipelineProfiler,
     StepTimer,
     TraceWindow,
     default_registry,
@@ -346,6 +347,16 @@ class Trainer:
             mode=health_mode(cfg),
             ema_decay=cfg.train.health_ema_decay,
             num_slots=cfg.num_slots,
+        )
+        # input-pipeline stage profiler (train.pipeline_metrics,
+        # docs/OBSERVABILITY.md "Input-pipeline attribution"): threaded
+        # through the TRAINING stream only (fit passes profiled=True to
+        # _coordinated_batches; eval streams stay unprofiled so a
+        # mid-run holdout pass never muddies the training attribution).
+        # None when off — every instrumented seam then takes its exact
+        # pre-profiler path, keeping off-runs byte-identical.
+        self.pipeline_prof = (
+            PipelineProfiler() if cfg.train.pipeline_metrics else None
         )
         # liveness heartbeat (train.heartbeat_path): tiny {step} records
         # the launcher watchdog and metrics_report --health read to flag
@@ -691,16 +702,28 @@ class Trainer:
         counts = np.asarray(multihost_utils.process_allgather(np.int32(local)))
         return int(counts.max()), local
 
-    def _with_arrays(self, batch, with_plan: bool = True, track_health: bool = True):
+    def _with_arrays(
+        self,
+        batch,
+        with_plan: bool = True,
+        track_health: bool = True,
+        profiler=None,
+    ):
         """(batch, step-input arrays) — validation + sorted-plan building
         happen HERE so that, wrapped in `prefetch`, the host-side sort
         overlaps device compute instead of serializing with dispatch.
         Training batches also feed the health monitor's touched-slot
-        bitmap here (same overlap argument; eval passes skip it)."""
+        bitmap here (same overlap argument; eval passes skip it).
+        `profiler` attributes the whole conversion — validation, sorted
+        plan, dedup, array build — as the "plan" stage."""
         self._check_batch(batch)
         if track_health:
             self._health.observe_batch(batch.slots, batch.mask)
-        return batch, self._batch_arrays(batch, with_plan=with_plan)
+        if profiler is None:
+            return batch, self._batch_arrays(batch, with_plan=with_plan)
+        with profiler.stage("plan"):
+            arrays = self._batch_arrays(batch, with_plan=with_plan)
+        return batch, arrays
 
     def _coordinated_batches(
         self,
@@ -711,6 +734,7 @@ class Trainer:
         track_health: bool = True,
         skip: int = 0,
         skips: Optional[dict] = None,
+        profiled: bool = False,
     ):
         """Yield exactly the globally-agreed number of (batch, arrays)
         pairs for this rank's shard stream, padding with fully-masked
@@ -737,12 +761,14 @@ class Trainer:
         `_shard` marker (popped by the consuming loop before the device
         transfer) so the fit loop can maintain the per-shard position
         the next checkpoint's data_state pins; padding pairs carry
-        none."""
+        none. `profiled` threads the pipeline profiler through the
+        parser/prefetch/plan seams (fit's training stream only)."""
         shards = [(self.rank, path)] if isinstance(path, str) else list(path)
         skips = dict(skips) if skips else {idx: skip for idx, _ in shards}
+        prof = self.pipeline_prof if profiled else None
 
         prepare = lambda b: self._with_arrays(
-            b, with_plan=with_plan, track_health=track_health
+            b, with_plan=with_plan, track_health=track_health, profiler=prof
         )
 
         def feed():
@@ -757,6 +783,7 @@ class Trainer:
                     p, self.cfg.data,
                     enforce_bad_rows=enforce_bad_rows, quarantine=quarantine,
                     skip=max(int(skips.get(idx, 0)), 0),
+                    profiler=prof,
                 ):
                     bb, arrays = prepare(b)
                     arrays["_shard"] = idx
@@ -767,19 +794,26 @@ class Trainer:
                 # legacy loudness: a single process with NO input at all
                 # is a user error, not an idle elastic rank
                 raise FileNotFoundError(shards[0][1] if shards else "<no shards>")
-            yield from prefetch(feed())
+            yield from prefetch(feed(), profiler=prof)
             return
         global_steps, local = self._epoch_batch_count(shards, skips)
         # open the real iterator whenever any shard exists (even if
         # counted 0) so the drift check below can catch a counter that
         # under-reads
         have_any = any(os.path.exists(p) for _, p in shards)
-        it = iter(prefetch(feed())) if have_any else iter(())
+        it = iter(prefetch(feed(), profiler=prof)) if have_any else iter(())
         produced = 0
         for _ in range(global_steps):
             pair = next(it, None)
             if pair is None:
-                pair = prepare(self._empty_batch())
+                # padding batches are built on the CONSUMER thread, so
+                # their plan time must NOT be attributed (it would land
+                # in the producer group while simultaneously counting
+                # as the consumer's data-wait — double attribution)
+                pair = self._with_arrays(
+                    self._empty_batch(),
+                    with_plan=with_plan, track_health=track_health,
+                )
             else:
                 produced += 1
             yield pair
@@ -859,6 +893,12 @@ class Trainer:
             # in append mode
             self.metrics.close()
             self.heartbeat.close()
+            if self.pipeline_prof is not None:
+                # drop the pipeline.* gauges from the (process-global)
+                # registry so a later profiler-off fit in this process
+                # snapshots no pipeline metrics (per-run zero-overhead
+                # contract); the next profiled fit's start() re-arms
+                self.pipeline_prof.close()
 
     def _fit(self, train_path: Optional[str] = None) -> TrainResult:
         cfg = self.cfg
@@ -876,6 +916,14 @@ class Trainer:
         steptimer = StepTimer()
         registry = default_registry()
         health = self._health
+        # input-pipeline attribution (train.pipeline_metrics): re-anchor
+        # the profiler clock at fit start so Trainer construction (state
+        # init) never reads as pipeline wall; None when off — the
+        # profiled branches below are then never taken and the record
+        # stream is byte-identical to a pre-profiler build
+        prof = self.pipeline_prof
+        if prof is not None:
+            prof.start()
         # operator stack dumps: `kill -USR1 <pid>` prints every thread's
         # stack (main-thread-only; restored in the finally), and the
         # optional no-progress watchdog dumps them automatically when no
@@ -1018,12 +1066,18 @@ class Trainer:
                     idx: max(int(skips.get(idx, 0)), 0) for idx, _ in epoch_shards
                 }
                 steps_in_epoch = max(self._shard_pos.values(), default=0)
+                # profiled consumer tiling: the end-of-iteration mark the
+                # next step's dispatch attribution continues from (None =
+                # no gap to claim: epoch start, or a checkpoint/eval just
+                # spent wall that is NOT per-step host work)
+                prof_mark = None
                 # quarantine on the FIRST pass only: later epochs see the
                 # same bad rows again (still counted/enforced), and one
                 # record per bad row beats epochs× duplicates
                 for batch, arrays in steptimer.batches(
                     self._coordinated_batches(
-                        epoch_shards, quarantine=epoch == 0, skips=skips
+                        epoch_shards, quarantine=epoch == 0, skips=skips,
+                        profiled=True,
                     )
                 ):
                     # which shard fed this step (None = a padding batch):
@@ -1033,13 +1087,50 @@ class Trainer:
                     if step_delay_s:  # drill injector (testing/faults.py)
                         time.sleep(step_delay_s)
                     arrays = self._resolve_fullshard_overflow(batch, arrays)
-                    arrays = self._shard_batch(arrays)
-                    self.state, m = self.train_step(self.state, arrays)
-                    # finish the PREVIOUS step's timing: the block on its
-                    # metrics overlaps this step's device execution, so
-                    # neither the timer, the health read, nor the guard
-                    # below adds a bubble
-                    steptimer.dispatched(m, batch.num_rows)
+                    if prof is None:
+                        arrays = self._shard_batch(arrays)
+                        self.state, m = self.train_step(self.state, arrays)
+                        # finish the PREVIOUS step's timing: the block on
+                        # its metrics overlaps this step's device
+                        # execution, so neither the timer, the health
+                        # read, nor the guard below adds a bubble
+                        steptimer.dispatched(m, batch.num_rows)
+                    else:
+                        # the consumer-side stage split — the SAME calls
+                        # as above with their boundaries stamped (no
+                        # extra sync), TILING the fit loop under the
+                        # StepTimer's own definitions: queue_wait = the
+                        # batch's full data-wait (time inside next()),
+                        # dispatch = every other host-side slice of the
+                        # step (fetch end -> dispatch return minus the
+                        # transfer refinement, plus the previous
+                        # iteration's tail bookkeeping: health reads,
+                        # guard checks, log writes — claimed via
+                        # prof_mark), device = the one-behind metrics
+                        # block. Tiling is what makes the attribution
+                        # coverage hit its >= 95% bar.
+                        t0 = time.perf_counter()
+                        arrays = self._shard_batch(arrays)
+                        t1 = time.perf_counter()
+                        self.state, m = self.train_step(self.state, arrays)
+                        t2 = time.perf_counter()
+                        steptimer.dispatched(m, batch.num_rows)
+                        t3 = time.perf_counter()
+                        wait_end = steptimer.last_wait_end or t0
+                        fetch_start = wait_end - steptimer.last_wait
+                        gap = (
+                            max(fetch_start - prof_mark, 0.0)
+                            if prof_mark is not None
+                            else 0.0
+                        )
+                        prof.add_many({
+                            "queue_wait": steptimer.last_wait,
+                            "transfer": t1 - t0,
+                            "dispatch": (t2 - t1)
+                            + max(t0 - wait_end, 0.0) + gap,
+                            "device": t3 - t2,
+                        })
+                        prof_mark = t3
                     # the previous step's metrics are ready now — the
                     # health scalars (norms, loss for the EMA) read free
                     health.collect()
@@ -1106,6 +1197,17 @@ class Trainer:
                         if counters:
                             rec["counters"] = counters
                         self.metrics.log(rec)
+                        if prof is not None:
+                            # the pipeline window rides the same log
+                            # cadence as its OWN kind="pipeline" record
+                            # (schema: docs/OBSERVABILITY.md
+                            # "Input-pipeline attribution")
+                            prec = prof.window_record()
+                            if prec:
+                                self.metrics.log(
+                                    {"kind": "pipeline", "step": res.steps,
+                                     **prec}
+                                )
                     if (
                         cfg.train.checkpoint_dir
                         and cfg.train.checkpoint_every
@@ -1123,6 +1225,10 @@ class Trainer:
                         self.save_checkpoint()
                         self.heartbeat.append({"step": res.steps})
                         hang.tick()  # a slow collective save is progress
+                        # a (possibly minutes-long) save is NOT per-step
+                        # host work: drop the tiling mark so the next
+                        # step's dispatch never claims it
+                        prof_mark = None
                     if kill_step and res.steps == kill_step:
                         # elastic-recovery drill (testing/faults.py):
                         # SIGKILL AFTER the checkpoint cadence above, so
@@ -1254,8 +1360,22 @@ class Trainer:
         # the final step's timing is still in flight (one behind); this
         # block is the single end-of-data sync the timer adds — the
         # health monitor's tail collect rides the same block
-        steptimer.flush()
-        health.flush()
+        if prof is None:
+            steptimer.flush()
+            health.flush()
+        else:
+            t0 = time.perf_counter()
+            steptimer.flush()
+            health.flush()
+            # the last step's metrics block belongs to its device stage
+            prof.add("device", time.perf_counter() - t0)
+            prec = prof.window_record()
+            if prec:
+                # the tail pipeline window, BEFORE the occupancy sweep
+                # below — post-loop host work is not pipeline wall
+                self.metrics.log(
+                    {"kind": "pipeline", "step": res.steps, **prec}
+                )
         res.seconds = time.perf_counter() - start
         # table occupancy: fraction of slots ever touched by a gradient —
         # the sparse-model health metric (SURVEY.md §5 "table-occupancy").
