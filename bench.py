@@ -51,13 +51,18 @@ def stage_row_batches(rng, num_slots: int, num_fields: int, K: int, B: int,
     return out
 
 
-def measure_e2e(args, model: str, rows: int) -> float:
+def measure_e2e(args, model: str, rows: int, use_cache: bool = False) -> float:
     """End-to-end trainer throughput: libffm file on disk → C++ parser →
     (sorted plan in the prefetch thread) → jitted device step. This is
     the number a user actually gets from `xflow train`, as opposed to
     the pre-staged device-only headline — the gap between them is the
     host data plane (docs/PERF.md "Host data plane"). Epoch 1 warms the
-    compile caches; epoch 2 is timed. Returns examples/sec."""
+    compile caches; epoch 2 is timed. Returns examples/sec.
+
+    `use_cache` packs the generated shard into the binary shard cache
+    first (data/shardcache.py — hash at convert time, mmap zero-copy
+    batches) and trains with data.cache=on: the parse/hash-free e2e
+    figure, paired with the text number as the measured host gap."""
     import os
     import tempfile
     import time as _time
@@ -89,8 +94,20 @@ def measure_e2e(args, model: str, rows: int) -> float:
                 "model.num_fields": 18,
                 "train.epochs": 1,
                 "train.pred_dump": False,
+                "data.cache": "on" if use_cache else "off",
             },
         )
+        if use_cache:
+            from xflow_tpu.data.shardcache import build_cache
+
+            t0 = _time.perf_counter()
+            built = build_cache(prefix, cfg.data)
+            print(
+                f"# e2e[{model}]: cache build {built['rows']} rows "
+                f"({built['bytes']} bytes) in "
+                f"{_time.perf_counter() - t0:.1f}s",
+                file=sys.stderr,
+            )
         trainer = Trainer(cfg)
         res_warm = trainer.fit()  # epoch 1: compile + first pass
         t0 = _time.perf_counter()
@@ -110,19 +127,23 @@ def bench_e2e(args) -> int:
     model = "fm" if args.model in ("all", "fm") else args.model
     rows = args.e2e_rows if not args.smoke else 20_000
     rate = measure_e2e(args, model, rows)
-    print(
-        json.dumps(
-            {
-                "metric": f"e2e_{model}_examples_per_sec",
-                "value": round(rate, 1),
-                "unit": "examples/sec",
-                "vs_baseline": round(rate / PER_CHIP_TARGET, 3),
-                # wall clock for trajectory correlation only; every
-                # duration above comes from time.perf_counter()
-                "ts": round(time.time(), 3),
-            }
-        )
-    )
+    rec = {
+        "metric": f"e2e_{model}_examples_per_sec",
+        "value": round(rate, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(rate / PER_CHIP_TARGET, 3),
+        # wall clock for trajectory correlation only; every
+        # duration above comes from time.perf_counter()
+        "ts": round(time.time(), 3),
+    }
+    if args.e2e_cache:
+        # the packed-shard-cache leg of the same workload: its
+        # `_examples_per_sec` suffix makes it its own gated
+        # perf_ledger group, and the speedup is the measured host gap
+        cached = measure_e2e(args, model, rows, use_cache=True)
+        rec[f"e2e_{model}_cached_examples_per_sec"] = round(cached, 1)
+        rec["cache_speedup"] = round(cached / rate, 3) if rate > 0 else None
+    print(json.dumps(rec))
     return 0
 
 
@@ -152,6 +173,11 @@ def main() -> int:
                     help="end-to-end pipeline bench (file -> C++ parser -> "
                          "sorted plan -> device) instead of pre-staged batches")
     ap.add_argument("--e2e-rows", type=int, default=1_000_000)
+    ap.add_argument("--e2e-cache", action="store_true",
+                    help="with --e2e: also measure the packed-shard-cache "
+                         "leg of the same workload (data/shardcache.py) — "
+                         "the record gains e2e_<model>_cached_examples_per_sec "
+                         "+ cache_speedup")
     args = ap.parse_args()
     if args.smoke:
         args.batch, args.log2_slots, args.scan_steps, args.repeats = 2048, 16, 4, 2

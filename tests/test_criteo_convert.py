@@ -180,6 +180,43 @@ def test_dirty_categorical_values_escaped_not_mistokenized():
         assert len(t.split(":")) == 3, t
 
 
+def test_convert_output_byte_stable_across_runs(tmp_path):
+    """Two conversions of the same input must produce BYTE-IDENTICAL
+    shard files — determinism is what makes the shard cache's crc32
+    digests meaningful (docs/DATA.md): a converter that stamped
+    timestamps, iteration order, or any run-local value into its
+    output would make every rebuilt cache look corrupted. Covers both
+    formats and the stdin path (same code path, one file fixture)."""
+    rng = np.random.default_rng(7)
+    raw = tmp_path / "raw.tsv"
+    # include a dirty categorical value so the escape path is pinned too
+    rows = list(_raw_criteo_rows(rng, 200))
+    rows[3] = "\t".join(["1"] + ["3"] * N_INT + ["a b:c%"] * N_CAT) + "\n"
+    raw.write_text("".join(rows))
+
+    def run(out):
+        with open(raw) as src:
+            stats = convert(src, str(out), 2)
+        assert stats["skipped"] <= 1  # the dirty row still converts
+        return [
+            (tmp_path / f"{out.name}-{s:05d}").read_bytes() for s in range(2)
+        ]
+
+    first = run(tmp_path / "a")
+    second = run(tmp_path / "b")
+    assert first == second, "criteo converter output is not byte-stable"
+
+    av = tmp_path / "a.csv"
+    av.write_text("id,click,h,c\n" + "".join(
+        f"i{k},{k % 2},{k},v{k}\n" for k in range(50)
+    ))
+    convert(open(av), str(tmp_path / "av1"), 1, fmt="avazu")
+    convert(open(av), str(tmp_path / "av2"), 1, fmt="avazu")
+    assert (tmp_path / "av1-00000").read_bytes() == (
+        tmp_path / "av2-00000"
+    ).read_bytes(), "avazu converter output is not byte-stable"
+
+
 def test_convert_shard_count_beyond_fd_limit_raises_early(tmp_path):
     """--shards beyond the process fd budget must fail with the clear
     up-front error, not EMFILE mid-stream (round-4 ADVICE)."""

@@ -119,6 +119,7 @@ PIPELINE_KEYS = (
     "hash_s",
     "batch_s",
     "pad_s",
+    "cache_read_s",
     "plan_s",
     "producer_wait_s",
     "queue_wait_s",
@@ -130,9 +131,14 @@ PIPELINE_KEYS = (
     "queue_depth",
     "queue_cap",
 )
+# pipeline keys added after runs were already archived (the round-12
+# packed-shard-cache stage): absence means a pre-upgrade writer, not a
+# schema violation — present they join the all-or-none gate and the
+# producer sum below (the OPTIONAL_SERVE_KEYS convention)
+OPTIONAL_PIPELINE_KEYS = ("cache_read_s",)
 PIPELINE_PRODUCER_SUM = (
-    "read_s", "parse_s", "hash_s", "batch_s", "pad_s", "plan_s",
-    "producer_wait_s",
+    "read_s", "parse_s", "hash_s", "batch_s", "pad_s", "cache_read_s",
+    "plan_s", "producer_wait_s",
 )
 PIPELINE_CONSUMER_SUM = ("queue_wait_s", "transfer_s", "dispatch_s", "device_s")
 # slack on the per-thread sum gate: stage accumulations batch on the
@@ -617,7 +623,10 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                 else:
                     seen_programs[prog_key] = i
             if kind == "pipeline":
-                pl_missing = [k for k in PIPELINE_KEYS if k not in rec]
+                pl_missing = [
+                    k for k in PIPELINE_KEYS
+                    if k not in rec and k not in OPTIONAL_PIPELINE_KEYS
+                ]
                 if pl_missing:
                     problems.append(
                         f"{tag}: record {i} lacks pipeline keys {pl_missing}"
@@ -632,7 +641,7 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                         ("producer", PIPELINE_PRODUCER_SUM),
                         ("consumer", PIPELINE_CONSUMER_SUM),
                     ):
-                        vals = [rec[k] for k in keys]
+                        vals = [rec[k] for k in keys if k in rec]
                         if not all(_finite(v) and v >= 0 for v in vals):
                             problems.append(
                                 f"{tag}: record {i} has a non-numeric or "
@@ -1075,8 +1084,9 @@ def render_pipeline_verdict(streams: dict, run_id: str) -> list[str]:
         f"  input pipeline ({windows} window(s)): "
         + pipeline_verdict(stages, wall),
         "    stages: "
-        + " | ".join(fmt(s) for s in ("parse", "plan", "producer_wait",
-                                      "queue_wait", "dispatch", "device")),
+        + " | ".join(fmt(s) for s in ("parse", "cache_read", "plan",
+                                      "producer_wait", "queue_wait",
+                                      "dispatch", "device")),
     ]
 
 

@@ -79,9 +79,12 @@ def _round_of(path: str):
 def _lower_is_better(metric, unit) -> bool:
     """Latency-shaped metrics (step_decompose's ms/step slices, serve
     p50/p99, the lab's ns/element cells) improve DOWNWARD — 'best' and
-    the regression direction flip relative to throughput."""
+    the regression direction flip relative to throughput. `_ratio`
+    metrics (the pipeline host-gap ratio) are gap-shaped: a round that
+    climbs back toward text-path ratios is the regression the
+    packed-shard-cache gate exists to catch."""
     return (
-        str(metric).endswith(("_ms", "_ns", "_ns_per_element"))
+        str(metric).endswith(("_ms", "_ns", "_ns_per_element", "_ratio"))
         or str(unit).startswith(("ms", "ns"))
     )
 
@@ -115,7 +118,8 @@ def normalize_bench(path: str, data) -> list[dict]:
             entry[key] = rec[key]
     out = [entry]
     # companion metrics ride in the same record (fm_examples_per_sec,
-    # zipf_*, *_s24_*, e2e_*...) — each becomes its own gated group
+    # zipf_*, *_s24_*, e2e_*..., and the pipeline record's text-path
+    # comparison leg) — each becomes its own gated group
     for key, v in rec.items():
         if key.endswith("_examples_per_sec") and key != rec["metric"] and _finite(v):
             out.append({
@@ -127,6 +131,31 @@ def normalize_bench(path: str, data) -> list[dict]:
                 "unit": "examples/sec",
                 "vs_baseline": rec.get(key.replace("_examples_per_sec", "_vs_baseline")),
             })
+    if str(rec["metric"]).startswith("pipeline_"):
+        # the host-gap record's own companion groups (BENCH_PIPELINE*,
+        # tools/pipeline_attrib.py): the gap ratio gates DOWNWARD (a
+        # round regressing back toward text-path ratios exits 3 —
+        # `_lower_is_better` keys on the `_ratio` suffix), and the
+        # cache-vs-text speedup gates upward like any throughput group
+        if _finite(rec.get("host_gap_ratio")):
+            out.append({
+                "series": "bench",
+                "round": rnd,
+                "path": os.path.basename(path),
+                "metric": "pipeline_host_gap_ratio",
+                "value": rec["host_gap_ratio"],
+                "unit": "x",
+            })
+        for key, v in rec.items():
+            if key.startswith("speedup_vs_") and _finite(v):
+                out.append({
+                    "series": "bench",
+                    "round": rnd,
+                    "path": os.path.basename(path),
+                    "metric": f"pipeline_{key}",
+                    "value": v,
+                    "unit": "x",
+                })
     return out
 
 
@@ -492,6 +521,36 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
                 f"| {_fmt(best['value'])} (r{_fmt(best['round'])}) "
                 f"| {_fmt(newest['value'])} "
                 f"| {_fmt(newest.get('achieved_gbps'))} |"
+            )
+        lines.append("")
+    pipe = groups_of([
+        e for e in entries
+        if e["series"] == "bench" and (
+            str(e["metric"]).startswith("pipeline_")
+            # the comparison legs pipeline_attrib --compare folds in,
+            # whatever --compare-label named them (text_e2e_..., native_
+            # e2e_..., the device-bound companion)
+            or str(e["metric"]).endswith("_e2e_examples_per_sec")
+            or str(e["metric"]) == "device_bound_examples_per_sec"
+        )
+    ])
+    if pipe:
+        # the host-gap trajectory in one place (BENCH_PIPELINE*,
+        # docs/PERF.md "Host data plane"): e2e vs device-bound vs the
+        # text-path comparison leg, ratio/speedup groups included —
+        # the bench table above already gates these, this section is
+        # the text-vs-cache story read top to bottom
+        lines += ["## Input pipeline (`BENCH_PIPELINE*.json`, host gap)", "",
+                  "| metric | rounds | first | newest |", "|---|---|---|---|"]
+        for (_, metric), group in sorted(pipe.items(), key=str):
+            vals = [e for e in group if _finite(e["value"])]
+            if not vals:
+                continue
+            rounds = [e["round"] for e in vals if e["round"] is not None]
+            lines.append(
+                f"| {metric} | {_fmt(min(rounds)) if rounds else '-'}→"
+                f"{_fmt(max(rounds)) if rounds else '-'} "
+                f"| {_fmt(vals[0]['value'])} | {_fmt(vals[-1]['value'])} |"
             )
         lines.append("")
     scale = [e for e in entries if e["series"] == "scale"]

@@ -169,6 +169,29 @@ def attribution(records: list[dict], run_id: str) -> dict:
     return out
 
 
+def compare_fields(rec: dict, other_path: str, label: str) -> dict:
+    """Fold a previous `--bench-json` record (e.g. the text-path run of
+    the same workload) into `rec` as a comparison: the other path's e2e
+    rate under `<label>_e2e_examples_per_sec` (the `_examples_per_sec`
+    suffix makes it its own gated ledger group — the text-vs-cache
+    trajectory), its host-gap ratio, and `speedup_vs_<label>`. This is
+    how the round-12 packed-shard-cache datapoint carries BOTH paths in
+    one record (docs/PERF.md "Host data plane")."""
+    with open(other_path) as f:
+        other = json.load(f)
+    base = other.get("value")
+    if not _finite(base) or base <= 0:
+        raise ValueError(
+            f"{other_path!r}: comparison record has no positive e2e value"
+        )
+    rec[f"{label}_e2e_examples_per_sec"] = base
+    for key in ("host_gap_ratio", "attributed_pct"):
+        if _finite(other.get(key)):
+            rec[f"{label}_{key}"] = other[key]
+    rec[f"speedup_vs_{label}"] = round(rec["value"] / base, 3)
+    return rec
+
+
 def bench_record(att: dict, rnd=None) -> dict:
     """The BENCH-shaped host-gap record (`--bench-json`), consumed by
     tools/perf_ledger.py: the e2e headline plus the device-bound
@@ -250,6 +273,14 @@ def main(argv=None) -> int:
     ap.add_argument("--round", type=int, default=None,
                     help="trajectory round stamped into the bench record "
                          "(perf_ledger gates rounds)")
+    ap.add_argument("--compare", default="", metavar="BENCH_JSON",
+                    help="a previous --bench-json record of the SAME "
+                         "workload on another input path (e.g. the "
+                         "text-path run) to fold into this record as "
+                         "<label>_e2e_examples_per_sec + speedup_vs_<label>")
+    ap.add_argument("--compare-label", default="text",
+                    help="label for the --compare record's keys "
+                         "(default: text)")
     args = ap.parse_args(argv)
 
     try:
@@ -286,7 +317,20 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        payload = json.dumps(bench_record(att, rnd=args.round))
+        rec = bench_record(att, rnd=args.round)
+        if args.compare:
+            try:
+                rec = compare_fields(rec, args.compare, args.compare_label)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"pipeline_attrib: --compare: {e}", file=sys.stderr)
+                return 2
+            lbl = args.compare_label
+            print(
+                f"vs {lbl}: {rec[f'speedup_vs_{lbl}']:.2f}x "
+                f"({rec[f'{lbl}_e2e_examples_per_sec']:,.0f} -> "
+                f"{rec['value']:,.0f} ex/s)"
+            )
+        payload = json.dumps(rec)
         if args.bench_json == "-":
             print(payload)
         else:

@@ -143,6 +143,7 @@ def run_model(name: str, args, data_dir=None, log2_slots=None,
     from xflow_tpu.config import Config, override
     from xflow_tpu.train.trainer import Trainer
 
+    use_cache = bool(getattr(args, "cache", False))
     cfg = override(
         Config(),
         **{
@@ -152,6 +153,13 @@ def run_model(name: str, args, data_dir=None, log2_slots=None,
             "data.batch_size": args.batch,
             "data.max_nnz": args.fields,
             "data.log2_slots": log2_slots or args.log2_slots,
+            # --cache: the parse/hash-free input path (data/shardcache.py);
+            # "on" so a missing/stale cache fails loudly instead of
+            # silently re-measuring the text path it claims to replace.
+            # The baseline leg pins "off" — NOT auto — so leftover .xfc
+            # files from a previous --cache run can never silently turn
+            # the text trajectory into an unlabeled cached measurement
+            "data.cache": "on" if use_cache else "off",
             "model.num_fields": args.fields,
             "train.epochs": args.epochs,
             "train.pred_dump": False,
@@ -165,6 +173,24 @@ def run_model(name: str, args, data_dir=None, log2_slots=None,
             **({"model.mvm_plus_one": args.mvm_plus_one} if name == "mvm" else {}),
         },
     )
+    if use_cache:
+        # build once per (data dir, hash config): build_cache skips
+        # shards whose cache is already fresh, so the second model on
+        # the same dataset pays ~nothing here
+        from xflow_tpu.data.shardcache import build_cache
+
+        t0 = time.perf_counter()
+        built = {}
+        for split in ("train", "test"):
+            built[split] = build_cache(
+                os.path.join(data_dir or args.data_dir, split), cfg.data
+            )
+        print(
+            f"# {name}: shard cache "
+            + json.dumps({k: v for k, v in built.items()})
+            + f" ({time.perf_counter() - t0:.1f}s)",
+            file=sys.stderr,
+        )
     trainer = Trainer(cfg)
     res = trainer.fit()
     t0 = time.perf_counter()
@@ -185,6 +211,10 @@ def run_model(name: str, args, data_dir=None, log2_slots=None,
     }
     if name == "mvm":
         rec["mvm_plus_one"] = args.mvm_plus_one
+    if use_cache:
+        # stamped so a merged BENCH_SCALE.json can never silently mix
+        # cached and text-path rounds under one unlabeled number
+        rec["cache"] = True
     print(f"# {name}: {json.dumps(rec)}", file=sys.stderr)
     return rec
 
@@ -213,6 +243,11 @@ def main() -> int:
     ap.add_argument("--ffm-log2-slots", type=int, default=22)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SCALE.json"))
     ap.add_argument("--force-gen", action="store_true")
+    ap.add_argument("--cache", action="store_true",
+                    help="pack the train/test shards into the binary shard "
+                         "cache first (data/shardcache.py) and run every "
+                         "model with data.cache=on — the parse/hash-free "
+                         "e2e numbers; each model record stamps cache=true")
     args = ap.parse_args()
 
     models = args.models.split(",")

@@ -218,6 +218,23 @@ class DataConfig:
     # same imbalance: one server owns the hot key) — raise this for
     # heavily skewed data; overflow fails loudly at plan time.
     fullshard_slack: float = 2.0
+    # packed shard cache (data/shardcache.py, docs/DATA.md): pre-hashed
+    # binary sidecars (`<shard>.xfc`, built once by
+    # `criteo_convert cache`) replace the per-epoch read/parse/hash/
+    # batch/pad producer stages with np.memmap zero-copy slices —
+    # batch assembly becomes an offset computation. "auto" (default)
+    # uses a shard's cache whenever one exists, is fresh for this
+    # config's hash parameters, and passes its crc32 digests (a stale
+    # cache warns and falls back; a CORRUPT one is quarantined with a
+    # logged text-path fallback — never a crash); "on" requires caches
+    # to exist (missing/stale raise loudly; corruption still only
+    # degrades); "off" never looks. Batches are bitwise-identical to
+    # the text path's either way (pinned by tests/test_shardcache.py).
+    cache: str = "auto"
+    # where the .xfc files live: "" = sibling of each text shard; a
+    # directory = `<cache_dir>/<shard basename>.xfc` (fast local disk
+    # for caches of shards on slow shared storage)
+    cache_dir: str = ""
     # bad-record budget (docs/ROBUSTNESS.md): a "bad" row is a labeled
     # line whose features ALL failed to parse (zero masked occurrences).
     # Both parsers keep such rows (a labeled line is an example), so an

@@ -27,6 +27,7 @@ import numpy as np
 from xflow_tpu.config import DataConfig
 from xflow_tpu.data.schema import SparseBatch, make_batch
 from xflow_tpu.data.libffm import QuarantineWriter, iter_examples
+from xflow_tpu.jsonl import JsonlAppender
 
 
 class BadRecordError(RuntimeError):
@@ -259,6 +260,75 @@ def batch_iterator(
     )
 
 
+def _cache_batch_iterator(
+    path: str, cfg: DataConfig, bs: int, profiler=None
+) -> Optional[Iterator[SparseBatch]]:
+    """The packed-shard-cache fast path (data.cache, docs/DATA.md):
+    the verified cache's zero-copy batch iterator for text shard
+    `path`, or None to take the text path.
+
+    Failure routing is the quarantine philosophy (docs/ROBUSTNESS.md):
+    a cache that fails its digest check — or cannot even be opened —
+    is recorded to data.quarantine_path (source/cache/reason/section,
+    the same stamped JSONL stream bad rows land in), counted
+    (`data.cache_fallbacks`), logged to stderr, and the shard falls
+    back to read/parse/hash — NEVER a crash, even under data.cache=on.
+    Only a MISSING or config-stale cache under "on" raises (the
+    operator asserted cached input; silently re-parsing text would
+    un-measure the very gap they forced the cache for)."""
+    if cfg.cache not in ("auto", "on"):
+        if cfg.cache != "off":
+            raise ValueError(
+                f"data.cache={cfg.cache!r}: expected auto|on|off"
+            )
+        return None
+    from xflow_tpu.data.shardcache import (
+        ShardCacheDigestError,
+        ShardCacheError,
+        ShardCacheStale,
+        cache_path_for,
+        resolve_cache,
+    )
+    from xflow_tpu.telemetry import default_registry
+
+    reg = default_registry()
+    try:
+        sc = resolve_cache(path, cfg)
+    except ShardCacheStale:
+        # only reaches here under cache=on (auto folds staleness into
+        # a warn-and-return-None inside resolve_cache): the operator
+        # asserted cached input and the cache is stale — loud, never a
+        # silent text fallback (it would re-measure the very path the
+        # cache was forced to replace). Staleness is not corruption:
+        # no quarantine record.
+        raise
+    except ShardCacheError as e:
+        section = getattr(e, "section", "?")
+        reg.counter("data.cache_fallbacks").inc()
+        qw = JsonlAppender(cfg.quarantine_path)
+        qw.append({
+            "source": path,
+            "cache": cache_path_for(path, cfg.cache_dir),
+            "reason": (
+                "cache_digest_mismatch"
+                if isinstance(e, ShardCacheDigestError)
+                else "cache_unreadable"
+            ),
+            "section": section,
+        })
+        qw.close()
+        print(
+            f"xflow: warning: shard cache for {path!r} failed integrity "
+            f"({e}); quarantined, falling back to the text path",
+            file=sys.stderr,
+        )
+        return None
+    if sc is None:
+        return None
+    reg.counter("data.cache_shards").inc()
+    return sc.iter_batches(bs, cfg.drop_remainder, profiler=profiler)
+
+
 def _raw_batch_iterator(
     path: str,
     cfg: DataConfig,
@@ -266,6 +336,10 @@ def _raw_batch_iterator(
     profiler=None,
 ) -> Iterator[SparseBatch]:
     bs = batch_size or cfg.batch_size
+    cached = _cache_batch_iterator(path, cfg, bs, profiler=profiler)
+    if cached is not None:
+        yield from cached
+        return
     if cfg.use_native_parser:
         native_iter = None
         try:

@@ -169,11 +169,70 @@ def convert(
     return {"rows": rows, "skipped": skipped, "fields": n_fields}
 
 
-def main(argv=None) -> int:
+def _add_cache_args(ap: argparse.ArgumentParser) -> None:
+    """The hash parameters a packed cache is built FOR (they are baked
+    into the stored slot ids — docs/DATA.md): must match the training
+    config's data.* values or the trainer will reject the cache as
+    stale."""
+    ap.add_argument("--log2-slots", type=int, default=22,
+                    help="table size the slots fold into (data.log2_slots)")
+    ap.add_argument("--hash-salt", type=int, default=0,
+                    help="feature-hash salt (data.hash_salt)")
+    ap.add_argument("--max-nnz", type=int, default=32,
+                    help="padded per-row feature capacity (data.max_nnz)")
+    ap.add_argument("--cache-dir", default="",
+                    help="where .xfc files go ('' = sibling of each shard; "
+                         "data.cache_dir)")
+
+
+def cache_main(argv) -> int:
+    """`criteo_convert cache <prefix>`: pack existing libffm text
+    shards into the binary shard cache (data/shardcache.py) — the
+    hash-at-convert-time pass that makes train-time batch assembly an
+    mmap offset computation (docs/DATA.md)."""
     ap = argparse.ArgumentParser(
-        description="stream raw Criteo/Avazu into rank-sharded libffm files"
+        prog="criteo_convert cache",
+        description="pack <prefix>-NNNNN libffm shards into .xfc binary "
+                    "caches (pre-hashed, crc32-digested, mmap'd at train "
+                    "time; docs/DATA.md)",
     )
-    ap.add_argument("src", help="raw file path, or - for stdin (zcat | ...)")
+    ap.add_argument("prefix", help="libffm shard prefix (reads <prefix>-NNNNN)")
+    _add_cache_args(ap)
+    ap.add_argument("--force", action="store_true",
+                    help="rebuild caches that are already fresh")
+    args = ap.parse_args(argv)
+    from xflow_tpu.config import Config, override
+    from xflow_tpu.data.shardcache import build_cache
+
+    cfg = override(Config(), **{
+        "data.log2_slots": args.log2_slots,
+        "data.hash_salt": args.hash_salt,
+        "data.max_nnz": args.max_nnz,
+        "data.cache_dir": args.cache_dir,
+    }).data
+    stats = build_cache(args.prefix, cfg, force=args.force)
+    import json
+
+    print(json.dumps(stats))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # git-style precedence: a literal first argument `cache` IS the
+    # subcommand; a raw dump actually named "cache" must be passed as
+    # `./cache` (the help says so)
+    if argv[:1] == ["cache"]:
+        return cache_main(argv[1:])
+    ap = argparse.ArgumentParser(
+        description="stream raw Criteo/Avazu into rank-sharded libffm files "
+                    "(subcommand `cache`: pack existing libffm shards into "
+                    "the binary shard cache)"
+    )
+    ap.add_argument("src", help="raw file path, or - for stdin (zcat | ...); "
+                                "a file literally named 'cache' must be "
+                                "passed as ./cache (bare 'cache' selects "
+                                "the subcommand)")
     ap.add_argument("out_prefix", help="writes <out_prefix>-%%05d")
     ap.add_argument("--shards", type=int, default=8,
                     help="one per training rank (rank k reads shard k)")
@@ -182,6 +241,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-header", action="store_true",
                     help="avazu: the stream has no CSV header (pre-split "
                          "chunks); the first line is data")
+    ap.add_argument("--cache", action="store_true",
+                    help="also build the binary shard cache in the same "
+                         "invocation (equivalent to a follow-up "
+                         "`criteo_convert cache <out_prefix>`)")
+    _add_cache_args(ap)
     args = ap.parse_args(argv)
     src = sys.stdin if args.src == "-" else open(args.src)
     try:
@@ -190,6 +254,17 @@ def main(argv=None) -> int:
     finally:
         if src is not sys.stdin:
             src.close()
+    if args.cache:
+        from xflow_tpu.config import Config, override
+        from xflow_tpu.data.shardcache import build_cache
+
+        ccfg = override(Config(), **{
+            "data.log2_slots": args.log2_slots,
+            "data.hash_salt": args.hash_salt,
+            "data.max_nnz": args.max_nnz,
+            "data.cache_dir": args.cache_dir,
+        }).data
+        stats["cache"] = build_cache(args.out_prefix, ccfg, force=True)
     import json
 
     print(json.dumps(stats))

@@ -330,6 +330,14 @@ class Trainer:
         # per-rank overflow fallback would desync collective programs
         if cfg.data.dedup not in ("auto", "off"):
             raise ValueError(f"data.dedup={cfg.data.dedup!r}: expected auto|off")
+        # packed shard cache (data/shardcache.py, docs/DATA.md):
+        # validated at CONSTRUCTION like the guard/dedup modes (identical
+        # config on every rank → rank-symmetric), not on the first shard
+        # open deep inside the prefetch thread
+        if cfg.data.cache not in ("auto", "on", "off"):
+            raise ValueError(
+                f"data.cache={cfg.data.cache!r}: expected auto|on|off"
+            )
         self._dedup_cap = (
             int(cfg.data.batch_size * cfg.data.max_nnz * cfg.data.dedup_cap_frac)
             if cfg.data.dedup == "auto" and jax.process_count() == 1
