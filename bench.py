@@ -379,7 +379,14 @@ def main() -> int:
                     )
             return batches
 
-        @jax.jit
+        from functools import partial
+
+        # donate the state like every production engine does (train/
+        # step.py and the three sharded builders): without it the K-step
+        # scan keeps TWO copies of tables+optimizer state live in HBM
+        # and benchmarks a memory profile the real step never has
+        # (XF703, docs/STATIC_ANALYSIS.md)
+        @partial(jax.jit, donate_argnums=(0,))
         def run_k_steps(state, batches):
             def body(st, batch):
                 st, m = step(st, batch)

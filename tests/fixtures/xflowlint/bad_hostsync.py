@@ -1,0 +1,57 @@
+"""XF110/XF111 fixture: host-sync taint in the hot loops (never run).
+
+Each marked line blocks the hot path on a device value dispatched in
+the SAME iteration — the sync-bubble class the one-step-behind
+StepTimer discipline exists to remove. The unmarked `staged` reads at
+the bottom of the fit loop are the DELIBERATE one-behind pattern and
+must stay silent: a newer dispatch has aged them, so the block hides
+under its device time (exemption by construction, not suppression).
+"""
+
+import jax
+import numpy as np
+
+
+class _Trainer:
+    def _fit(self, batches):
+        state = object()
+        staged = None
+        for batch in batches:
+            state, m = self.train_step(state, batch)
+            loss = float(m["loss"])  # XF110: same-iteration loss read
+            print(m["rows"])  # XF110: print forces the transfer
+            if m["update_ok"]:  # XF111: implicit bool sync in a branch
+                continue
+            note = f"grad={m['grad_norm']}"  # XF110: f-string interpolation
+            self.log(loss, note)
+            # one-behind: staged LAST iteration, aged by this
+            # iteration's dispatch — reading it here is the sanctioned
+            # discipline and must NOT fire
+            if staged is not None:
+                self.emit(float(staged["loss"]))
+            staged = m
+        # post-run epilogue: this loop dispatches NOTHING, so its
+        # blocking reads are mandatory one-time syncs, not bubbles —
+        # exempt by construction (only dispatching loops can stall)
+        for key in ("loss", "rows"):
+            self.emit(float(m[key]))
+
+
+class _Server:
+    def __init__(self, make_step):
+        self.eval_step = make_step()
+        self.out = []
+
+    def _worker_loop(self):
+        while True:
+            group = self.take()
+            p = self.eval_step(group)
+            self.out.append(np.asarray(p))  # XF110: same-iteration readback
+            if bool(p.sum()):  # XF110: bool() blocks on the batch
+                break
+
+
+def prefetch(iterator, q):
+    for item in iterator:
+        dev = jax.device_put(item)
+        q.put(int(dev[0]))  # XF110: int() blocks on the fresh transfer

@@ -1,0 +1,30 @@
+"""XF701/XF702/XF703 fixture: sharding-contract violations (never run).
+
+The XF704 cross-engine checks need several engine builders in one
+source set, so they are exercised by the scratch-tree drills in
+tools/smoke_lint.sh and tests/test_xflowlint.py instead of a fixture.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def undeclared_axis(mesh):
+    # the mesh declares ('data', 'table') — this fails inside GSPMD
+    # partitioning at run time, in lint now
+    return NamedSharding(mesh, P("tabel", None))  # XF701: misspelled axis
+
+
+def donated_read(step_fn, state, batch):
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+    new_state = jitted(state, batch)
+    # works on CPU test runs, corrupts/crashes on TPU: the donated
+    # buffer was invalidated by the call above
+    return state, new_state  # XF702: donated buffer read
+
+
+def undonated_train_step():
+    def train_step(state, batch):
+        return state
+
+    return jax.jit(train_step)  # XF703: train-step jit without donation

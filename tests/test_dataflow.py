@@ -1,0 +1,345 @@
+"""Unit tests for the flow-sensitive dataflow engine
+(xflow_tpu/analysis/dataflow.py): abstract-value joins, tuple
+unpacking, loop fixpoints with freshness aging, scope-aware local-call
+return propagation, and the closure/staging boundary that makes the
+one-behind discipline exempt BY CONSTRUCTION — the semantics the
+XF110/XF111, XF702, and retrofitted XF202 rules are built on."""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from xflow_tpu.analysis import dataflow  # noqa: E402
+from xflow_tpu.analysis.core import Module  # noqa: E402
+from xflow_tpu.analysis.dataflow import (  # noqa: E402
+    BOTTOM, AbsVal, Dataflow, Hooks, join, join_env,
+)
+
+
+def mod(src: str) -> Module:
+    return Module("m.py", "m.py", src)
+
+
+DEVICE = AbsVal(tags=frozenset({"device"}), fresh=True)
+
+
+class TaintHooks(Hooks):
+    """`make()` is a device source (ages the env); `sink()` records the
+    abstract value of its argument at every call site."""
+
+    propagate_returns = True
+
+    def __init__(self):
+        # line -> joined AbsVal: a loop body is visited once per
+        # fixpoint pass, so per-site observations join (the production
+        # passes get the same effect from core.run_passes' dedup)
+        self._by_line: dict = {}
+        self.loads = {}  # name -> last loaded AbsVal
+
+    @property
+    def sinks(self):
+        return sorted(self._by_line.items())
+
+    def at_call(self, node, callee, argvals, kwvals, env, df, fval):
+        if callee == "make":
+            for k, v in list(env.items()):
+                if v.fresh:
+                    env[k] = dataflow.replace(v, fresh=False)
+            return DEVICE
+        if callee == "sink" and argvals:
+            cur = self._by_line.get(node.lineno)
+            self._by_line[node.lineno] = argvals[0] if cur is None \
+                else join(cur, argvals[0])
+        return None
+
+    def at_load(self, node, name, val, env, df):
+        if name:
+            self.loads[name] = val
+
+
+def run(src: str, hooks=None):
+    hooks = hooks or TaintHooks()
+    Dataflow(mod(src), hooks).run_all()
+    return hooks
+
+
+# ----------------------------------------------------------------- joins
+
+
+def test_join_unions_tags_and_keeps_common_identity():
+    a = AbsVal(tags=frozenset({"device"}), fresh=True, spec="P('data')")
+    b = AbsVal(tags=frozenset({"donated"}), spec="P('data')")
+    j = join(a, b)
+    assert j.tags == {"device", "donated"}
+    assert j.fresh  # may-fresh: fresh on any path
+    assert j.spec == "P('data')"  # agreeing identity facts survive
+    assert join(a, AbsVal(spec="P('table')")).spec is None  # disagreeing don't
+
+
+def test_env_join_keeps_one_sided_bindings():
+    e = join_env({"x": DEVICE}, {"y": AbsVal(tags=frozenset({"loopvar"}))})
+    assert e["x"].tagged("device") and e["y"].tagged("loopvar")
+
+
+def test_branch_join_is_may_union():
+    h = run(
+        "def f(c):\n"
+        "    if c:\n"
+        "        x = make()\n"
+        "    else:\n"
+        "        x = 1\n"
+        "    sink(x)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device")  # tainted on SOME path -> tainted
+
+
+# ------------------------------------------------------------- unpacking
+
+
+def test_tuple_unpack_taints_every_target():
+    h = run(
+        "def f(b):\n"
+        "    state, m = make()\n"
+        "    sink(state)\n"
+        "    sink(m)\n"
+    )
+    assert all(v.tagged("device") for _ln, v in h.sinks)
+    assert len(h.sinks) == 2
+
+
+def test_literal_tuple_unpacks_elementwise():
+    h = run(
+        "def f(b):\n"
+        "    d = make()\n"
+        "    d2, host = (d, 1)\n"
+        "    sink(d2)\n"
+        "    sink(host)\n"
+    )
+    by_line = dict(h.sinks)
+    assert by_line[4].tagged("device")
+    assert not by_line[5].tagged("device")
+
+
+def test_subscript_and_attribute_propagate_taint():
+    h = run(
+        "def f(b):\n"
+        "    m = make()\n"
+        "    sink(m['loss'])\n"
+        "    sink(m.loss)\n"
+        "    sink(m.sum())\n"  # method call on a tainted object
+    )
+    assert all(v.tagged("device") for _ln, v in h.sinks)
+
+
+# ------------------------------------------------- loops, joins, freshness
+
+
+def test_loop_join_reaches_fixpoint_and_ages_staleness():
+    """The one-behind shape: a value staged LAST iteration is stale at
+    this iteration's read (a newer dispatch aged it); the value made
+    THIS iteration is fresh. Exactly the XF110 exempt/fire split."""
+    h = run(
+        "def f(batches):\n"
+        "    staged = None\n"
+        "    for b in batches:\n"
+        "        m = make()\n"
+        "        sink(m)\n"
+        "        sink(staged)\n"
+        "        staged = m\n"
+    )
+    by_line = dict(h.sinks)
+    assert by_line[5].tagged("device") and by_line[5].fresh
+    assert by_line[6].tagged("device") and not by_line[6].fresh
+
+
+def test_loop_variable_carries_its_binding_loop():
+    h = run(
+        "def f(xs):\n"
+        "    for k in xs:\n"
+        "        sink(k)\n"
+        "    sink(k)\n"
+    )
+    by_line = dict(h.sinks)
+    assert by_line[3].tagged("loopvar") and by_line[3].loops
+    # after the loop the fact (may-)persists, but the binding-loop ids
+    # let a consumer check enclosure — the XF202 retrofit's precision
+    assert by_line[4].tagged("loopvar")
+
+
+def test_loopvar_killed_by_rebinding():
+    h = run(
+        "def f(xs):\n"
+        "    for k in xs:\n"
+        "        k = 3\n"
+        "        sink(k)\n"
+    )
+    (_ln, val), = h.sinks
+    assert not val.tagged("loopvar")
+
+
+def test_loopvar_propagates_through_copies_and_arithmetic():
+    h = run(
+        "def f(xs):\n"
+        "    for k in xs:\n"
+        "        n = k + 1\n"
+        "        sink(n)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("loopvar")
+
+
+def test_while_loop_fixpoint_terminates():
+    h = run(
+        "def f(c):\n"
+        "    x = 0\n"
+        "    while c:\n"
+        "        x = make()\n"
+        "        sink(x)\n"
+    )
+    assert h.sinks and all(v.tagged("device") for _ln, v in h.sinks)
+
+
+# ------------------------------------------------- call-graph propagation
+
+
+def test_local_call_return_propagates():
+    h = run(
+        "def produce():\n"
+        "    return make()\n"
+        "\n"
+        "def f(b):\n"
+        "    x = produce()\n"
+        "    sink(x)\n"
+    )
+    assert any(v.tagged("device") for _ln, v in h.sinks)
+
+
+def test_scope_aware_resolution_prefers_visible_def():
+    """Two same-named helpers in different functions must not
+    cross-link (the jit-purity precision property, now shared)."""
+    h = run(
+        "def a():\n"
+        "    def helper():\n"
+        "        return make()\n"
+        "    return helper()\n"
+        "\n"
+        "def b():\n"
+        "    def helper():\n"
+        "        return 1\n"
+        "    sink(helper())\n"
+    )
+    # b's helper is host-only: its sink must NOT see a's device value
+    assert all(not v.tagged("device") for _ln, v in h.sinks)
+
+
+def test_nested_def_returning_through_outer_call():
+    h = run(
+        "def outer():\n"
+        "    def inner():\n"
+        "        return make()\n"
+        "\n"
+        "    def use():\n"
+        "        x = inner()\n"
+        "        sink(x)\n"
+    )
+    assert any(v.tagged("device") for _ln, v in h.sinks)
+
+
+def test_recursion_terminates():
+    h = run(
+        "def f(n):\n"
+        "    if n:\n"
+        "        return f(n - 1)\n"
+        "    return make()\n"
+        "\n"
+        "def g():\n"
+        "    sink(f(3))\n"
+    )
+    assert h.sinks  # no hang, no crash
+
+
+# ------------------------------------------- closures: the staging seam
+
+
+def test_closure_free_variables_are_bottom():
+    """A nested function reading a value staged by its enclosing scope
+    sees BOTTOM — the staging seam is the construction that exempts the
+    trainer's check_pending-style one-behind closures."""
+    h = run(
+        "def f(batches):\n"
+        "    pending = None\n"
+        "    def check():\n"
+        "        m, at = pending\n"
+        "        sink(m)\n"
+        "    for b in batches:\n"
+        "        x = make()\n"
+        "        check()\n"
+        "        pending = (x, 1)\n"
+    )
+    closure_vals = [v for ln, v in h.sinks if ln == 5]
+    assert closure_vals and all(not v.tagged("device")
+                                for v in closure_vals)
+
+
+def test_try_finally_preserves_bindings():
+    """Regression pin: a try/finally with NO except handlers must not
+    wipe the environment (an aliasing bug once silently dropped every
+    binding made inside the try body — masking real taint downstream)."""
+    h = run(
+        "def f(b):\n"
+        "    try:\n"
+        "        x = make()\n"
+        "    finally:\n"
+        "        cleanup()\n"
+        "    sink(x)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device") and val.fresh
+
+
+def test_try_except_joins_handler_paths():
+    h = run(
+        "def f(b):\n"
+        "    x = 1\n"
+        "    try:\n"
+        "        x = make()\n"
+        "    except ValueError:\n"
+        "        x = 2\n"
+        "    sink(x)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device")
+
+
+def test_fstring_and_branch_hooks_fire():
+    class H(TaintHooks):
+        def __init__(self):
+            super().__init__()
+            self.branches = []
+            self.formats = []
+
+        def at_branch(self, node, val, env, df):
+            self.branches.append(val)
+
+        def at_format(self, node, val, env, df):
+            self.formats.append(val)
+
+    h = run(
+        "def f(b):\n"
+        "    m = make()\n"
+        "    if m:\n"
+        "        pass\n"
+        "    s = f'loss={m}'\n",
+        H(),
+    )
+    assert any(v.tagged("device") for v in h.branches)
+    assert any(v.tagged("device") for v in h.formats)
+
+
+def test_module_level_statements_are_analyzed():
+    h = run("x = make()\nsink(x)\n")
+    (_ln, val), = h.sinks
+    assert val.tagged("device")

@@ -113,6 +113,28 @@ def test_nan_batch_halt_commits_then_raises(dataset, tmp_path):
         assert np.isfinite(np.asarray(tab)).all(), name
 
 
+def test_halt_on_final_step_still_writes_staged_log_record(dataset, tmp_path):
+    """The XF110 one-behind log staging must not lose the halting
+    step's record: a NaN on the run's LAST data step halts post-loop,
+    and the staged metrics line (the run's most diagnostic one) is
+    written before NonFiniteHalt raises."""
+    mpath = tmp_path / "m" / "metrics.jsonl"
+    cfg = make_cfg(
+        dataset,
+        **{"train.nonfinite_guard": "halt",
+           "train.metrics_path": str(mpath)},
+    )
+    t = Trainer(cfg)
+    poison_nan_batches(t, steps=[12])  # 600 rows / 100 x 2 epochs = final
+    with pytest.raises(NonFiniteHalt):
+        t.fit()
+    recs = [json.loads(l) for l in open(mpath)]
+    steps = [r for r in recs if "loss" in r and "step" in r]
+    assert [r["step"] for r in steps][-1] == 12
+    assert steps[-1]["loss"] is None  # discarded step: strict-JSON null
+    assert any(r.get("nonfinite_halt") for r in recs)
+
+
 def test_consecutive_bad_steps_abort_under_skip(dataset, tmp_path):
     ck = tmp_path / "ck"
     cfg = make_cfg(

@@ -299,15 +299,18 @@ def health_run(tmp_path, monkeypatch):
 
 
 def test_trainer_health_fields_and_ema_oracle(health_run):
-    """Every post-first log record carries the full health key set; the
-    logged EMA replays exactly from the logged per-step losses (the
-    health read runs one step behind, so the EMA at step i covers
-    losses 1..i-1)."""
+    """EVERY log record carries the full health key set, and the logged
+    EMA replays exactly from the logged per-step losses, covering
+    losses 1..i at the record for step i: since the XF110 fix the
+    trainer stages each log-cadence record and writes it one step
+    BEHIND (under the next step's device time), by which point the
+    health collect for the record's own step has already run — so not
+    even the first record is health-blind any more."""
     run, _ = health_run
     recs = read_jsonl(str(run / "metrics_rank0.jsonl"))
     steps = [r for r in recs if "step" in r and "loss" in r]
     health = [r for r in steps if "grad_norm" in r]
-    assert len(health) == len(steps) - 1  # step 1 runs one behind
+    assert len(health) == len(steps)  # one-behind write: all covered
     for r in health:
         for key in ("grad_norm", "update_norm", "param_norm", "loss_ema",
                     "grad_norm_max", "slots_touched", "table_occupancy",
@@ -317,8 +320,8 @@ def test_trainer_health_fields_and_ema_oracle(health_run):
     losses = {r["step"]: r["loss"] for r in steps}
     ema = None
     for r in health:
-        prev = losses[r["step"] - 1]
-        ema = prev if ema is None else 0.9 * ema + 0.1 * prev
+        cur = losses[r["step"]]
+        ema = cur if ema is None else 0.9 * ema + 0.1 * cur
         assert r["loss_ema"] == pytest.approx(ema, rel=1e-4), r["step"]
     # streaming evals landed mid-run, stamped with the step
     evals = [r for r in recs if "eval_auc" in r]

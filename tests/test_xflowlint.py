@@ -125,6 +125,49 @@ def test_shell_fixture_fires_strict_mode_and_bad_key():
     assert "train.log_evry" in f401.message
 
 
+def test_hostsync_fixture_fires_on_every_marker():
+    findings = lint("bad_hostsync.py")
+    by_rule = {r: [f for f in findings if f.rule == r]
+               for r in rules_of(findings)}
+    assert set(by_rule) == {"XF110", "XF111"}
+    assert {f.line for f in by_rule["XF110"]} == marker_lines(
+        "bad_hostsync.py", "XF110")
+    assert {f.line for f in by_rule["XF111"]} == marker_lines(
+        "bad_hostsync.py", "XF111")
+    blob = " ".join(f.message for f in findings)
+    # explicit conversions, formatting, and the implicit branch all land
+    for needle in ("float", "print", "f-string", "bool", "int",
+                   "branch condition"):
+        assert needle in blob, needle
+
+
+def test_hostsync_one_behind_staged_read_is_exempt_by_construction():
+    """The fixture's `staged` reads model the StepTimer discipline: the
+    value was staged LAST iteration and a newer dispatch aged it — no
+    suppression comment involved, the engine proves it stale. The
+    post-run epilogue loop (dispatches nothing, only reads) is the
+    other by-construction exemption: its syncs are mandatory one-time
+    reads, not pipeline bubbles."""
+    src = open(os.path.join(FIXTURES, "bad_hostsync.py")).read()
+    exempt = {i for i, ln in enumerate(src.splitlines(), 1)
+              if 'float(staged["loss"])' in ln or 'float(m[key])' in ln}
+    assert len(exempt) == 2
+    findings = lint("bad_hostsync.py")
+    assert not (exempt & {f.line for f in findings})
+
+
+def test_sharding_contract_fixture_fires_on_every_marker():
+    findings = lint("bad_sharding_contract.py")
+    by_rule = {r: [f for f in findings if f.rule == r]
+               for r in rules_of(findings)}
+    assert set(by_rule) == {"XF701", "XF702", "XF703"}
+    for rule in by_rule:
+        assert {f.line for f in by_rule[rule]} == marker_lines(
+            "bad_sharding_contract.py", rule), rule
+    (f701,) = by_rule["XF701"]
+    assert "'tabel'" in f701.message and "data, table" in f701.message
+
+
 def test_unrecorded_jit_fires_only_in_recorder_scoped_paths(tmp_path):
     """XF204 is scoped to the engine/serve modules where PR 7's
     CompileRecorder contract holds."""
@@ -162,6 +205,95 @@ def test_loop_var_static_check_is_scope_local(tmp_path):
         "def call(k):\n    return g(1.0, k)\n"
     )
     assert lint(str(mod), rules=["XF202"]) == []
+
+
+def test_loop_var_after_loop_is_single_valued(tmp_path):
+    """XF202 retrofit regression pin: a loop variable read AFTER its
+    loop is one value per outer execution — the old name-set heuristic
+    flagged it (the documented scope-locality caveat); the dataflow
+    engine must not."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\ndef f(x, n):\n    return x * n\n\n\n"
+        "g = jax.jit(f, static_argnums=(1,))\n\n\n"
+        "def post_loop(x, xs):\n"
+        "    for k in xs:\n        x = x + k\n"
+        "    return g(x, k)\n"
+    )
+    assert lint(str(mod), rules=["XF202"]) == []
+
+
+def test_loop_var_copied_through_alias_is_caught(tmp_path):
+    """XF202 retrofit gain: `n = k; g(x, n)` inside the loop varies per
+    iteration exactly like passing `k` directly — the name heuristic
+    missed it, the dataflow engine follows the assignment."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\ndef f(x, n):\n    return x * n\n\n\n"
+        "g = jax.jit(f, static_argnums=(1,))\n\n\n"
+        "def aliased(x, xs):\n"
+        "    for k in xs:\n"
+        "        n = k\n"
+        "        x = g(x, n)\n"
+        "    return x\n"
+    )
+    findings = lint(str(mod), rules=["XF202"])
+    assert [f.rule for f in findings] == ["XF202"]
+    assert findings[0].line == 14  # the call site, not the alias line
+
+
+def test_loop_var_rebound_to_constant_is_clean(tmp_path):
+    """XF202 retrofit: rebinding the name to a constant inside the loop
+    kills the loop-variance fact (flow-sensitivity, not name matching)."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\ndef f(x, n):\n    return x * n\n\n\n"
+        "g = jax.jit(f, static_argnums=(1,))\n\n\n"
+        "def rebound(x, xs):\n"
+        "    for k in xs:\n"
+        "        k = 3\n"
+        "        x = g(x, k)\n"
+        "    return x\n"
+    )
+    assert lint(str(mod), rules=["XF202"]) == []
+
+
+def test_donated_read_in_loop_without_rebind_is_caught(tmp_path):
+    """XF702: the donate-then-reuse loop (forgot `state = step(state)`)
+    — the second iteration passes an invalidated buffer."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\ndef run(step, state, batches):\n"
+        "    jitted = jax.jit(step, donate_argnums=(0,))\n"
+        "    outs = []\n"
+        "    for b in batches:\n"
+        "        outs.append(jitted(state, b))\n"
+        "    return outs\n"
+    )
+    findings = lint(str(mod), rules=["XF702"])
+    assert findings and {f.rule for f in findings} == {"XF702"}
+    # the rebound form is the fix and must be clean
+    mod.write_text(
+        "import jax\n\n\ndef run(step, state, batches):\n"
+        "    jitted = jax.jit(step, donate_argnums=(0,))\n"
+        "    for b in batches:\n"
+        "        state, m = jitted(state, b)\n"
+        "    return state\n"
+    )
+    assert lint(str(mod), rules=["XF702"]) == []
+
+
+def test_undonated_eval_step_is_not_flagged(tmp_path):
+    """XF703 keys on the TrainState parameter: eval/predict jits take
+    read-only `tables` and must NOT be asked to donate them."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\ndef make_eval():\n"
+        "    def eval_step(tables, batch):\n"
+        "        return tables\n"
+        "    return jax.jit(eval_step)\n"
+    )
+    assert lint(str(mod), rules=["XF703"]) == []
 
 
 def test_lockset_private_thread_only_helper_not_external(tmp_path):
@@ -381,6 +513,221 @@ def test_cli_unknown_rule_is_usage_error():
     assert run_cli("--rules", "XF999").returncode == 3
 
 
+# ------------------------------------------------- engine-contract matrix
+
+
+def test_contract_artifact_checked_in_and_byte_stable():
+    """tools/engine_contracts.json: covers all four engine builders,
+    matches a fresh extraction, and two consecutive extractions render
+    byte-identically (ISSUE 14 acceptance)."""
+    from xflow_tpu.analysis.passes.sharding_contract import (
+        ENGINE_MODULES, extract_contracts, render_artifact,
+    )
+
+    project = Project.load(REPO_ROOT)
+    r1 = render_artifact(extract_contracts(project))
+    r2 = render_artifact(extract_contracts(Project.load(REPO_ROOT)))
+    assert r1 == r2, "extraction is not deterministic"
+    on_disk = open(os.path.join(REPO_ROOT, "tools",
+                                "engine_contracts.json")).read()
+    assert r1 == on_disk, (
+        "checked-in engine_contracts.json is stale — regenerate with "
+        "tools/xflowlint.py --write-contracts and review the diff")
+    data = json.loads(r1)
+    assert set(data["engines"]) == set(ENGINE_MODULES)
+    assert data["declared_mesh_axes"] == ["data", "table"]
+
+
+def test_contract_matrix_covers_known_invariants():
+    """Spot-check the matrix against facts the builders guarantee
+    today: every train program donates the state, every engine covers
+    the core trace scopes, the sorted-sharded table rides
+    P('table', None)."""
+    data = json.load(open(os.path.join(REPO_ROOT, "tools",
+                                       "engine_contracts.json")))
+    train_programs = 0
+    for rel, eng in data["engines"].items():
+        for name, prog in eng["programs"].items():
+            if name.startswith("train_step"):
+                train_programs += 1
+                assert prog["donate_argnums"] == [0], (rel, name)
+    assert train_programs == 4  # one train program per builder
+    ss = data["engines"]["xflow_tpu/parallel/sorted_sharded.py"]
+    assert ss["leaf_specs"]["wv"] == ["NamedSharding(P('table', None))"]
+    assert ss["leaf_specs"]["wv.n"] == ss["leaf_specs"]["wv.z"]
+    for rel, eng in data["engines"].items():
+        if rel == "xflow_tpu/parallel/train_step.py":
+            continue  # inherits the shared step's scopes by delegation
+        assert {"gather", "loss", "grad", "optimizer"} <= set(eng["scopes"]), rel
+
+
+def test_cli_check_contracts_green_then_drift_exits_4(tmp_path):
+    """--check-contracts: 0 on a faithful tree, 4 (distinct from
+    finding growth) when a builder's contract changed without
+    regenerating the artifact."""
+    root = tmp_path / "tree"
+    for rel in ("xflow_tpu/train/step.py", "xflow_tpu/parallel/mesh.py",
+                "xflow_tpu/parallel/train_step.py",
+                "xflow_tpu/parallel/sorted_sharded.py",
+                "xflow_tpu/parallel/sorted_fullshard.py",
+                "tools/engine_contracts.json"):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO_ROOT, rel), dst)
+    r = run_cli("--root", str(root), "--check-contracts")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # drop the donation from one builder: contract drift, exit 4
+    sf = root / "xflow_tpu/parallel/sorted_sharded.py"
+    sf.write_text(sf.read_text().replace("donate_argnums=(0,),", ""))
+    r = run_cli("--root", str(root), "--check-contracts")
+    assert r.returncode == 4 and "CONTRACT DRIFT" in r.stderr
+
+
+def test_xf704_scope_drift_across_builders(tmp_path):
+    """Renaming one builder's 'optimizer' scope (present in every other
+    builder) fires XF704 on that builder only."""
+    root = tmp_path / "tree"
+    for rel in ("xflow_tpu/train/step.py", "xflow_tpu/parallel/mesh.py",
+                "xflow_tpu/parallel/train_step.py",
+                "xflow_tpu/parallel/sorted_sharded.py",
+                "xflow_tpu/parallel/sorted_fullshard.py"):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO_ROOT, rel), dst)
+    project = Project.load(str(root))
+    assert [f for f in run_passes(project) if f.rule == "XF704"] == []
+    sf = root / "xflow_tpu/parallel/sorted_sharded.py"
+    sf.write_text(sf.read_text().replace(
+        'named_scope("optimizer")', 'named_scope("optimzer")'))
+    findings = [f for f in run_passes(Project.load(str(root)))
+                if f.rule == "XF704"]
+    assert len(findings) == 1
+    assert findings[0].path == "xflow_tpu/parallel/sorted_sharded.py"
+    assert "'optimizer'" in findings[0].message
+
+
+def test_xf704_silent_on_partial_scan_without_shared_step():
+    """A partial scan holding the parallel builders but NOT the shared
+    single-device step must not false-fire XF704 on the delegating
+    GSPMD builder: the shared step's scopes load from disk (like the
+    mesh axes do)."""
+    findings = lint(os.path.join(REPO_ROOT, "xflow_tpu", "parallel"))
+    assert [f for f in findings if f.rule == "XF704"] == []
+
+
+def test_xf704_partial_scan_matches_full_tree_verdict():
+    """The comparison roster is always the full builder set (missing
+    builders load from disk), so the exact --changed file set that used
+    to false-fire — the shared step plus ONE parallel builder, where
+    'every other builder' collapsed to the step's scope superset —
+    stays clean, matching the full-tree verdict."""
+    findings = lint(
+        os.path.join(REPO_ROOT, "xflow_tpu", "train", "step.py"),
+        os.path.join(REPO_ROOT, "xflow_tpu", "parallel",
+                     "sorted_sharded.py"))
+    assert [f for f in findings if f.rule == "XF704"] == []
+
+
+def test_hostsync_jit_construction_does_not_age(tmp_path):
+    """Constructing a jit callable dispatches nothing: it must not age
+    a same-iteration device value into exemption (XF110 stays live)."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import jax\n\n\nclass T:\n"
+        "    def _fit(self, bs):\n"
+        "        for b in bs:\n"
+        "            s, m = self.train_step(None, b)\n"
+        "            fn = jax.jit(lambda v: v)\n"
+        "            x = float(m['loss'])\n"
+    )
+    findings = lint(str(mod), rules=["XF110"])
+    assert [f.rule for f in findings] == ["XF110"]
+    assert findings[0].line == 9
+
+
+def test_xf704_intra_builder_leaf_spec_disagreement(tmp_path):
+    """One builder declaring two different shardings for the same table
+    leaf is contract drift between its own programs."""
+    root = tmp_path / "tree"
+    for rel in ("xflow_tpu/train/step.py", "xflow_tpu/parallel/mesh.py",
+                "xflow_tpu/parallel/train_step.py",
+                "xflow_tpu/parallel/sorted_sharded.py",
+                "xflow_tpu/parallel/sorted_fullshard.py"):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO_ROOT, rel), dst)
+    sf = root / "xflow_tpu/parallel/sorted_sharded.py"
+    sf.write_text(
+        sf.read_text()
+        + "\n\n_drifted = {\"wv\": NamedSharding(None, P(None, None))}\n"
+    )
+    findings = [f for f in run_passes(Project.load(str(root)))
+                if f.rule == "XF704"]
+    assert len(findings) == 1
+    assert "'wv'" in findings[0].message
+
+
+# ------------------------------------------------------ CLI: jobs/changed
+
+
+def test_jobs_fanout_output_identical():
+    """-j N must produce byte-identical findings to the serial sweep
+    (the pre-commit speed path cannot change verdicts)."""
+    bad = os.path.join(FIXTURES, "bad_hostsync.py")
+    bad2 = os.path.join(FIXTURES, "bad_sharding_contract.py")
+    serial = run_cli(bad, bad2, "--no-baseline", "--json")
+    fanned = run_cli(bad, bad2, "--no-baseline", "--json", "--jobs", "2")
+    assert serial.returncode == fanned.returncode == 1
+    assert json.loads(serial.stdout)["new"] == json.loads(fanned.stdout)["new"]
+
+
+def test_changed_lints_only_git_changed_files(tmp_path):
+    """--changed in a scratch git repo: clean tree -> nothing to lint;
+    a modified module -> linted and gated."""
+    import subprocess as sp
+
+    root = tmp_path / "repo"
+    (root / "xflow_tpu").mkdir(parents=True)
+    (root / "xflow_tpu" / "mod.py").write_text("x = 1\n")
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        sp.run(cmd, cwd=root, env=env, check=True, capture_output=True)
+    r = run_cli("--root", str(root), "--changed")
+    assert r.returncode == 0 and "no lintable changed files" in r.stderr
+    # introduce a finding in a tracked file -> --changed catches it
+    (root / "xflow_tpu" / "mod.py").write_text(
+        "import jax, time\n\n\n@jax.jit\ndef f(x):\n"
+        "    return x + time.time()\n")
+    r = run_cli("--root", str(root), "--changed")
+    assert r.returncode == 1 and "XF101" in r.stdout
+
+
+def test_partial_scan_never_stales_full_tree_only_rules(tmp_path):
+    """XF402 (dead-key) only runs on full-tree scans: a partial scan
+    that covers the entry's file must still not call it stale (it
+    would block the --changed pre-commit path with a bogus exit 2)."""
+    bl = tmp_path / "bl.json"
+    base = Baseline([BaselineEntry(
+        "XF402", "xflow_tpu/config.py", "m", reason="accepted dead key")])
+    base.save(str(bl))
+    r = run_cli(os.path.join(REPO_ROOT, "xflow_tpu", "config.py"),
+                "--baseline", str(bl))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_baseline_staleness_scoped_to_scanned_paths():
+    """A --changed-style partial scan must not call entries in
+    untouched files stale (Baseline.split only_paths)."""
+    base = Baseline([BaselineEntry("XF101", "a.py", "m", reason="legacy")])
+    _new, _known, stale = base.split([], only_paths={"b.py"})
+    assert stale == []
+    _new, _known, stale = base.split([], only_paths={"a.py"})
+    assert len(stale) == 1
+
+
 # ----------------------------------------- seeded violations (acceptance)
 
 SEEDS = [
@@ -418,6 +765,44 @@ SEEDS = [
      "\ndef _seeded(app):\n"
      "    app.append({'kind': 'serve', 'qqps': 1})  # SEED\n",
      "{'kind': 'serve'"),
+    ("XF110",
+     "xflow_tpu/train/trainer.py",
+     "\n\nclass _SeededSync:\n"
+     "    def _fit(self, batches):\n"
+     "        state = None\n"
+     "        for b in batches:\n"
+     "            state, m = self.train_step(state, b)\n"
+     "            print(float(m['loss']))  # SEED\n",
+     "SEED"),
+    ("XF111",
+     "xflow_tpu/train/trainer.py",
+     "\n\nclass _SeededBranch:\n"
+     "    def _fit(self, batches):\n"
+     "        state = None\n"
+     "        for b in batches:\n"
+     "            state, m = self.train_step(state, b)\n"
+     "            if m['update_ok']:  # SEED\n"
+     "                break\n",
+     "SEED"),
+    ("XF701",
+     "xflow_tpu/parallel/mesh.py",
+     "\n\ndef _seeded_axis(mesh):\n"
+     "    return NamedSharding(mesh, P('tabel', None))  # SEED\n",
+     "SEED"),
+    ("XF702",
+     "xflow_tpu/parallel/mesh.py",
+     "\n\ndef _seeded_donated(step, state, b):\n"
+     "    jitted = jax.jit(step, donate_argnums=(0,))\n"
+     "    out = jitted(state, b)\n"
+     "    return out, state  # SEED\n",
+     "SEED"),
+    ("XF703",
+     "xflow_tpu/parallel/mesh.py",
+     "\n\ndef _seeded_nodonate():\n"
+     "    def train_step(state, batch):\n"
+     "        return state\n\n"
+     "    return jax.jit(train_step)  # SEED\n",
+     "SEED"),
 ]
 
 

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Pre-commit gate: xflowlint over the commit's changed files + the
+# engine-contract drift check + ruff (when installed).
+#
+# Install:   ln -sf ../../tools/pre-commit.sh .git/hooks/pre-commit
+# Run solo:  bash tools/pre-commit.sh
+#
+# Fast by construction: --changed lints only git-touched lintable
+# files (worktree + staged + untracked), --jobs 0 fans the per-module
+# passes over a worker pool (cpu count, capped at 8), and the contract
+# check re-extracts four builder modules only.
+#
+# Caveat, stated plainly: like most lint hooks this checks WORKTREE
+# content, not the staged index — `git add` then editing the violation
+# away without re-adding commits the staged copy unchecked. CI's
+# full-tree sweep (tools/smoke_lint.sh) remains the authority. A clean run is well under a second on a
+# warm tree; the full-repo sweep stays in tools/smoke_lint.sh / CI.
+set -euo pipefail
+# $0 may be the .git/hooks/pre-commit SYMLINK — a plain dirname would
+# land in .git/hooks; resolve the link to the real tools/ location
+cd "$(dirname "$(readlink -f "$0")")/.."
+export PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
+
+rc=0
+python tools/xflowlint.py --changed --jobs 0 || rc=$?
+if [ "$rc" -eq 1 ]; then
+    echo "pre-commit: xflowlint found NEW findings — fix them, or" \
+         "suppress a deliberate single site with a reasoned" \
+         "'# xflowlint: disable=RULE'" >&2
+    exit "$rc"
+elif [ "$rc" -eq 2 ]; then
+    echo "pre-commit: STALE baseline entries — this commit fixes" \
+         "baselined findings, so remove their entries from" \
+         "tools/xflowlint_baseline.json (the baseline only shrinks)" >&2
+    exit "$rc"
+elif [ "$rc" -ne 0 ]; then
+    echo "pre-commit: xflowlint failed (exit $rc)" >&2
+    exit "$rc"
+fi
+
+# contract drift only matters when an engine builder (or the mesh)
+# changed — cheap enough to just always check
+if ! python tools/xflowlint.py --check-contracts; then
+    echo "pre-commit: engine-contract matrix drifted — regenerate with" \
+         "'python tools/xflowlint.py --write-contracts' and commit the" \
+         "reviewed diff" >&2
+    exit 4
+fi
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+fi
+echo "pre-commit: OK"

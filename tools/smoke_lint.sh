@@ -11,8 +11,12 @@
 #    finding must also remove its entry).
 # 4. Seeded-violation drill: one violation of each rule class seeded
 #    into a scratch copy of a REAL module is caught with the correct
-#    rule id and file:line.
-# 5. ruff (the pinned generic-Python layer, pyproject.toml) runs clean
+#    rule id and file:line (4b: XF704 cross-engine drift via a
+#    four-builder scratch tree with one trace scope renamed).
+# 5. Engine-contract matrix: checked-in tools/engine_contracts.json is
+#    current and byte-stable; un-regenerated builder edits exit 4
+#    (distinct from finding growth).
+# 6. ruff (the pinned generic-Python layer, pyproject.toml) runs clean
 #    when installed; skipped with a notice where the container lacks it.
 #
 # Standalone:    bash tools/smoke_lint.sh [workdir]
@@ -58,11 +62,13 @@ expect_rules bad_lockset.py XF301     # the pre-PR 8 appender, forever
 expect_rules bad_config.py XF401
 expect_rules bad_schema.py XF501 XF502
 expect_rules bad_shell.sh XF401 XF601
+expect_rules bad_hostsync.py XF110 XF111
+expect_rules bad_sharding_contract.py XF701 XF702 XF703
 expect_silent good_lockset.py
 expect_silent good_clean.py
 expect_silent suppress_line.py
 expect_silent suppress_file.py
-echo "smoke_lint: fixture corpus behaves (6 bad fire, 4 good silent)"
+echo "smoke_lint: fixture corpus behaves (8 bad fire, 4 good silent)"
 
 # ---- 3. baseline growth + shrink mechanics --------------------------------
 BL="$WORK/baseline.json"
@@ -147,9 +153,109 @@ seed XF501 xflow_tpu/serve/metrics.py <<'EOF'
 def _lint_seeded_drift(app):
     app.append({"kind": "serve", "qqps": 1})  # SEED
 EOF
-echo "smoke_lint: seeded-violation drill OK (5 rule classes, exact file:line)"
+seed XF110 xflow_tpu/train/trainer.py <<'EOF'
 
-# ---- 5. ruff: the pinned generic-Python layer -----------------------------
+
+class _LintSeededSync:
+    def _fit(self, batches):
+        state = None
+        for b in batches:
+            state, m = self.train_step(state, b)
+            print(float(m["loss"]))  # SEED
+EOF
+seed XF111 xflow_tpu/train/trainer.py <<'EOF'
+
+
+class _LintSeededBranch:
+    def _fit(self, batches):
+        state = None
+        for b in batches:
+            state, m = self.train_step(state, b)
+            if m["update_ok"]:  # SEED
+                break
+EOF
+seed XF701 xflow_tpu/parallel/sorted_sharded.py <<'EOF'
+
+
+def _lint_seeded_axis(mesh):
+    return NamedSharding(mesh, P("tabel", None))  # SEED
+EOF
+seed XF702 xflow_tpu/train/step.py <<'EOF'
+
+
+def _lint_seeded_donated(step_fn, state, batch):
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+    out = jitted(state, batch)
+    return out, state  # SEED
+EOF
+seed XF703 xflow_tpu/parallel/train_step.py <<'EOF'
+
+
+def _lint_seeded_nodonate():
+    def train_step(state, batch):
+        return state
+
+    return jax.jit(train_step)  # SEED
+EOF
+echo "smoke_lint: seeded-violation drill OK (10 rule classes, exact file:line)"
+
+# ---- 4b. XF704 cross-engine drift needs all four builders in one root ----
+DRIFT="$WORK/drift_tree"
+mkdir -p "$DRIFT/xflow_tpu/train" "$DRIFT/xflow_tpu/parallel"
+cp xflow_tpu/train/step.py "$DRIFT/xflow_tpu/train/"
+cp xflow_tpu/parallel/train_step.py xflow_tpu/parallel/sorted_sharded.py \
+   xflow_tpu/parallel/sorted_fullshard.py xflow_tpu/parallel/mesh.py \
+   "$DRIFT/xflow_tpu/parallel/"
+python tools/xflowlint.py --root "$DRIFT" --no-baseline >/dev/null 2>&1 \
+    || { echo "smoke_lint: faithful builder copies must lint clean"; exit 1; }
+# rename one builder's "optimizer" scope: every OTHER builder covers it
+sed -i 's/named_scope("optimizer")/named_scope("optimzer")/' \
+    "$DRIFT/xflow_tpu/parallel/sorted_sharded.py"
+line=$(grep -n 'jax.named_scope' "$DRIFT/xflow_tpu/parallel/sorted_sharded.py" \
+    | head -1 | cut -d: -f1)
+out=$(python tools/xflowlint.py --root "$DRIFT" --no-baseline 2>/dev/null || true)
+grep -qE "sorted_sharded.py:$line: XF704" <<<"$out" || {
+    echo "smoke_lint: seeded XF704 scope drift not caught at" \
+         "sorted_sharded.py:$line"; echo "$out"; exit 1; }
+echo "smoke_lint: XF704 cross-engine scope-drift drill OK"
+
+# ---- 5. engine-contract matrix: checked in, byte-stable, drift-gated ------
+# (docs/DISTRIBUTED.md "Engine contract matrix"; exit 4 is DISTINCT
+# from finding growth so CI can tell "new bug" from "stale oracle")
+python tools/xflowlint.py --check-contracts >/dev/null
+CONTRACT="$WORK/contract_tree"
+mkdir -p "$CONTRACT/xflow_tpu/train" "$CONTRACT/xflow_tpu/parallel" \
+         "$CONTRACT/tools"
+cp xflow_tpu/train/step.py "$CONTRACT/xflow_tpu/train/"
+cp xflow_tpu/parallel/train_step.py xflow_tpu/parallel/sorted_sharded.py \
+   xflow_tpu/parallel/sorted_fullshard.py xflow_tpu/parallel/mesh.py \
+   "$CONTRACT/xflow_tpu/parallel/"
+cp tools/engine_contracts.json "$CONTRACT/tools/"
+python tools/xflowlint.py --root "$CONTRACT" --check-contracts >/dev/null \
+    || { echo "smoke_lint: contract check must pass on faithful copies"; exit 1; }
+# byte stability: two consecutive regenerations are identical, and both
+# match the checked-in artifact
+python tools/xflowlint.py --root "$CONTRACT" --write-contracts >/dev/null
+cp "$CONTRACT/tools/engine_contracts.json" "$WORK/contracts_r1.json"
+python tools/xflowlint.py --root "$CONTRACT" --write-contracts >/dev/null
+cmp -s "$WORK/contracts_r1.json" "$CONTRACT/tools/engine_contracts.json" || {
+    echo "smoke_lint: contract artifact not byte-stable across two runs"
+    exit 1; }
+cmp -s "$WORK/contracts_r1.json" tools/engine_contracts.json || {
+    echo "smoke_lint: checked-in engine_contracts.json is stale —" \
+         "regenerate with tools/xflowlint.py --write-contracts"
+    exit 1; }
+# drift gate: change a builder's contract (drop the donation) without
+# regenerating -> exit 4, distinct from finding growth (1) / stale (2)
+sed -i 's/donate_argnums=(0,),//' \
+    "$CONTRACT/xflow_tpu/parallel/sorted_sharded.py"
+rc=0; python tools/xflowlint.py --root "$CONTRACT" --check-contracts \
+    >/dev/null 2>&1 || rc=$?
+[ "$rc" -eq 4 ] || {
+    echo "smoke_lint: contract drift must exit 4, got $rc"; exit 1; }
+echo "smoke_lint: engine-contract matrix OK (stable, covered, drift=4)"
+
+# ---- 6. ruff: the pinned generic-Python layer -----------------------------
 if command -v ruff >/dev/null 2>&1; then
     ruff check .
     echo "smoke_lint: ruff layer green ($(ruff --version))"

@@ -7,7 +7,9 @@ repo (or explicit paths) and gates against the checked-in baseline:
     python tools/xflowlint.py                       # full repo, baselined
     python tools/xflowlint.py xflow_tpu/serve       # subset (no dead-key)
     python tools/xflowlint.py --rules XF301         # one rule family
+    python tools/xflowlint.py --changed -j 8        # pre-commit fast path
     python tools/xflowlint.py --write-baseline      # re-record legacy set
+    python tools/xflowlint.py --check-contracts     # engine-contract gate
     python tools/xflowlint.py --list-rules
 
 Exit codes (tools/smoke_lint.sh relies on these):
@@ -15,6 +17,9 @@ Exit codes (tools/smoke_lint.sh relies on these):
     1  NEW findings (not in the baseline)
     2  STALE baseline entries (a fixed finding must leave the baseline)
     3  usage / internal error
+    4  CONTRACT drift — the extracted engine-contract matrix differs
+       from the checked-in tools/engine_contracts.json (regenerate
+       with --write-contracts and review the diff)
 
 The baseline (tools/xflowlint_baseline.json) makes the gate fail on
 *growth*, not existence; inline `# xflowlint: disable=RULE` handles
@@ -38,6 +43,105 @@ from xflow_tpu.analysis.core import (  # noqa: E402
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "xflowlint_baseline.json")
 
 
+def _changed_paths(root: str) -> list:
+    """Files git considers changed (worktree vs HEAD, staged, and
+    untracked), filtered to the default lintable set. The pre-commit
+    fast path: lint what the commit touches, gate growth against the
+    repo baseline."""
+    import subprocess
+
+    out: set = set()
+    cmds = (
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        ["git", "diff", "--name-only", "--cached", "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    for cmd in cmds:
+        try:
+            r = subprocess.run(cmd, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except Exception:
+            continue
+        if r.returncode != 0:
+            continue
+        out.update(ln.strip() for ln in r.stdout.splitlines() if ln.strip())
+    keep = []
+    for rel in sorted(out):
+        norm = rel.replace(os.sep, "/")
+        if "tests/fixtures" in norm:
+            continue
+        lintable = (
+            (norm.startswith("xflow_tpu/") and norm.endswith(".py"))
+            or (norm.startswith("tools/") and "/" not in norm[len("tools/"):]
+                and norm.endswith((".py", ".sh")))
+            or norm in ("bench.py", "conftest.py")
+        )
+        if lintable and os.path.exists(os.path.join(root, rel)):
+            keep.append(os.path.join(root, rel))
+    return keep
+
+
+def _contract_artifact_path(root: str) -> str:
+    return os.path.join(root, "tools", "engine_contracts.json")
+
+
+def _contracts_mode(args, write: bool) -> int:
+    """--write-contracts / --check-contracts: the engine-contract
+    matrix gate (docs/DISTRIBUTED.md "Engine contract matrix")."""
+    from xflow_tpu.analysis.passes.sharding_contract import (
+        ENGINE_MODULES, MESH_MODULE, extract_contracts, render_artifact,
+    )
+
+    # only the builder sources (+ the mesh axis anchor) feed the matrix
+    # — loading them alone keeps the pre-commit contract check cheap
+    wanted = [os.path.join(args.root, *rel.split("/"))
+              for rel in ENGINE_MODULES + (MESH_MODULE,)]
+    project = Project.load(args.root,
+                           [p for p in wanted if os.path.exists(p)] or None)
+    contracts = extract_contracts(project)
+    missing = [m for m in ENGINE_MODULES if m not in contracts["engines"]]
+    if missing:
+        print(
+            "xflowlint: engine builders missing from the source tree: "
+            + ", ".join(missing), file=sys.stderr)
+        return 3
+    rendered = render_artifact(contracts)
+    path = _contract_artifact_path(args.root)
+    if write:
+        with open(path, "w") as f:
+            f.write(rendered)
+        print(f"xflowlint: wrote engine-contract matrix for "
+              f"{len(contracts['engines'])} builder(s) to {path}")
+        return 0
+    try:
+        with open(path) as f:
+            on_disk = f.read()
+    except OSError as e:
+        print(f"xflowlint: cannot read contract artifact: {e}",
+              file=sys.stderr)
+        return 4
+    if on_disk == rendered:
+        print(f"xflowlint: engine-contract matrix matches {path} "
+              f"({len(contracts['engines'])} builders)")
+        return 0
+    import difflib
+
+    diff = difflib.unified_diff(
+        on_disk.splitlines(), rendered.splitlines(),
+        fromfile="checked-in", tofile="extracted", lineterm="", n=2)
+    lines = list(diff)[:40]
+    print("xflowlint: CONTRACT DRIFT — a builder's extracted sharding "
+          "contract differs from tools/engine_contracts.json:",
+          file=sys.stderr)
+    for ln in lines:
+        print(f"  {ln}", file=sys.stderr)
+    print("xflowlint: if the change is intended, regenerate with "
+          "`python tools/xflowlint.py --write-contracts` and review "
+          "the diff (it is the unified-builder acceptance oracle)",
+          file=sys.stderr)
+    return 4
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="xflowlint", description=__doc__,
@@ -56,6 +160,21 @@ def main(argv=None) -> int:
                          "(audit reasons by hand afterwards)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (e.g. XF101,XF301)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-changed files (worktree, staged, "
+                         "untracked), growth-gated against the repo "
+                         "baseline — the pre-commit fast path")
+    ap.add_argument("--jobs", "-j", type=int, default=1,
+                    help="fan per-module passes out over N processes "
+                         "(0 = cpu count, capped at 8 — more workers "
+                         "than file chunks just pay fork cost); output "
+                         "is identical to -j 1")
+    ap.add_argument("--write-contracts", action="store_true",
+                    help="regenerate tools/engine_contracts.json (the "
+                         "engine sharding-contract matrix)")
+    ap.add_argument("--check-contracts", action="store_true",
+                    help="fail with exit 4 if the extracted contract "
+                         "matrix drifted from tools/engine_contracts.json")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--list-rules", action="store_true")
@@ -64,29 +183,56 @@ def main(argv=None) -> int:
     import xflow_tpu.analysis.passes  # noqa: F401  (register)
 
     if args.list_rules:
-        for name, (_fn, rules) in sorted(PASS_REGISTRY.items()):
+        for name, (_fn, rules, _scope) in sorted(PASS_REGISTRY.items()):
             print(f"{name}: {', '.join(rules)}")
         return 0
+
+    if args.write_contracts or args.check_contracts:
+        if args.paths or args.changed:
+            print("xflowlint: --write/check-contracts operates on the "
+                  "whole tree under --root; drop the explicit paths",
+                  file=sys.stderr)
+            return 3
+        return _contracts_mode(args, write=args.write_contracts)
+
+    jobs = args.jobs
+    if jobs == 0:
+        jobs = min(os.cpu_count() or 1, 8)
 
     only = None
     if args.rules:
         only = {r.strip() for r in args.rules.split(",") if r.strip()}
-        known = {r for _n, (_f, rs) in PASS_REGISTRY.items() for r in rs}
+        known = {r for _n, (_f, rs, _s) in PASS_REGISTRY.items() for r in rs}
         bad = only - known - {"XF001"}
         if bad:
             print(f"xflowlint: unknown rule(s): {', '.join(sorted(bad))}",
                   file=sys.stderr)
             return 3
 
+    paths = args.paths or None
+    if args.changed:
+        if args.paths:
+            print("xflowlint: --changed selects its own path set; drop "
+                  "the explicit paths", file=sys.stderr)
+            return 3
+        paths = _changed_paths(args.root)
+        if not paths:
+            print("xflowlint: --changed: no lintable changed files",
+                  file=sys.stderr)
+            return 0
+
     try:
-        project = Project.load(args.root, args.paths or None)
+        project = Project.load(args.root, paths)
     except OSError as e:
         print(f"xflowlint: {e}", file=sys.stderr)
         return 3
-    findings = run_passes(project, only_rules=only)
+    findings = run_passes(project, only_rules=only, jobs=jobs)
 
     baseline_path = args.baseline
-    if baseline_path is None and project.full_tree and not args.no_baseline:
+    if baseline_path is None and not args.no_baseline \
+            and (project.full_tree or args.changed):
+        # --changed still gates GROWTH against the repo baseline (its
+        # staleness check is scoped to the scanned files below)
         baseline_path = DEFAULT_BASELINE
     baseline = Baseline() if (args.no_baseline or not baseline_path) \
         else Baseline.load(baseline_path)
@@ -132,7 +278,18 @@ def main(argv=None) -> int:
               f"{'y' if len(out.entries) == 1 else 'ies'} to {target}")
         return 0
 
-    new, based, stale = baseline.split(findings, only_rules=only)
+    scanned = None
+    if args.changed:
+        scanned = {m.relpath for m in project.modules} \
+            | {s.relpath for s in project.shell_scripts}
+    new, based, stale = baseline.split(findings, only_rules=only,
+                                       only_paths=scanned)
+    if not project.full_tree:
+        # dead-key-style analyses never ran on this partial scan: their
+        # entries cannot have been "fixed" by it
+        from xflow_tpu.analysis.core import FULL_TREE_RULES
+
+        stale = [e for e in stale if e.rule not in FULL_TREE_RULES]
 
     if args.json:
         import dataclasses

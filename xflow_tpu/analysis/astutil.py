@@ -113,6 +113,83 @@ def const_str(node: ast.AST) -> Optional[str]:
     return None
 
 
+def defs_by_name(defs: list) -> dict:
+    """simple name -> [qualnames] over a func_defs() list."""
+    by_name: dict = {}
+    for qn, _node, _cls in defs:
+        by_name.setdefault(qn.split(".")[-1], []).append(qn)
+    return by_name
+
+
+def resolve_scoped(simple: str, caller_qn: str, by_name: dict) -> list:
+    """Scope-aware name resolution: among same-named definitions, pick
+    the ones whose defining scope is an ancestor of the caller's scope,
+    preferring the innermost (two `def one(...)` in different functions
+    must never cross-link — that is how a host helper would get marked
+    jit-reachable). Falls back to every candidate for `self.x` refs."""
+    cands = by_name.get(simple, [])
+    if len(cands) <= 1:
+        return list(cands)
+    visible = []
+    for c in cands:
+        scope = c.rsplit(".", 1)[0] if "." in c else ""
+        if scope == "" or caller_qn == scope or caller_qn.startswith(
+                scope + "."):
+            visible.append((len(scope.split(".")) if scope else 0, c))
+    if not visible:
+        return list(cands)
+    best = max(d for d, _c in visible)
+    return [c for d, c in visible if d == best]
+
+
+def scope_sites(tree: ast.AST, defs: list):
+    """Yields (caller qualname, node) for every node, attributed to its
+    innermost enclosing function ('' = module level)."""
+    covered: dict = {}
+    for qn, node, _cls in defs:
+        for sub in walk_scope(node):
+            covered.setdefault(id(sub), (qn, sub))
+    # module-level statements (not inside any def)
+    seen_ids = set(covered)
+    for node in ast.walk(tree):
+        if id(node) not in seen_ids and not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            covered.setdefault(id(node), ("", node))
+    return covered.values()
+
+
+def local_call_graph(defs: list) -> dict:
+    """qualname -> set of callee qualnames (module-local, scope-aware:
+    a call binds to the innermost visible same-named definition)."""
+    by_name = defs_by_name(defs)
+    graph: dict = {}
+    for qn, node, _cls in defs:
+        callees: set = set()
+        for sub in walk_scope(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            cn = call_name(sub)
+            if cn is None:
+                continue
+            simple = cn.split(".")[-1]
+            if cn == simple or cn == f"self.{simple}" or cn == f"cls.{simple}":
+                callees.update(resolve_scoped(simple, qn, by_name))
+        graph[qn] = callees
+    return graph
+
+
+def reachable(roots: set, graph: dict) -> set:
+    seen = set(roots)
+    stack = list(roots)
+    while stack:
+        cur = stack.pop()
+        for nxt in graph.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return seen
+
+
 def func_defs(tree: ast.AST) -> list:
     """Every (qualname, node, class_name) function/method in a module.
     Qualnames use '.' ('Cls.method', 'outer.inner')."""

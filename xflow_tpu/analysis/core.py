@@ -279,12 +279,16 @@ class Baseline:
             json.dump(data, f, indent=2, sort_keys=False)
             f.write("\n")
 
-    def split(self, findings: list, only_rules: Optional[set] = None) -> tuple:
+    def split(self, findings: list, only_rules: Optional[set] = None,
+              only_paths: Optional[set] = None) -> tuple:
         """-> (new_findings, baselined_findings, stale_entries).
 
         `only_rules` scopes the STALENESS check to entries of the rules
         that actually ran — a `--rules XF301` run must not report an
-        XF401 entry stale just because the config pass was skipped."""
+        XF401 entry stale just because the config pass was skipped.
+        `only_paths` scopes it the same way to the files that were
+        actually scanned (a `--changed` run must not report an entry in
+        an untouched file stale)."""
         fps = {}
         for f in findings:
             fps.setdefault((f.rule, f.path, f.message), []).append(f)
@@ -295,60 +299,135 @@ class Baseline:
                 if (f.rule, f.path, f.message) in known]
         stale = [e for e in self.entries
                  if (e.rule, e.path, e.message) not in fps
-                 and (only_rules is None or e.rule in only_rules)]
+                 and (only_rules is None or e.rule in only_rules)
+                 and (only_paths is None or e.path in only_paths)]
         return new, base, stale
 
 
 # ------------------------------------------------------------ pass driver
 
+# rules whose analysis only runs on FULL-tree scans (a partial scan
+# cannot fire them, so a partial scan must not call their baseline
+# entries stale either — the --changed pre-commit path)
+FULL_TREE_RULES = ("XF402",)
+
 # populated by xflow_tpu.analysis.passes at import; maps pass name ->
-# (runner, rule ids) so the CLI can list and select
+# (runner, rule ids, scope) so the CLI can list and select. scope
+# "module" = findings derive from one file at a time (parallelizable
+# across a worker pool); "project" = needs the whole source set at
+# once (cross-module comparisons, dead-key analysis).
 PASS_REGISTRY: dict[str, tuple] = {}
 
 
-def register_pass(name: str, rules: tuple) -> Callable:
+def register_pass(name: str, rules: tuple, scope: str = "module") -> Callable:
+    assert scope in ("module", "project"), scope
+
     def deco(fn: Callable) -> Callable:
-        PASS_REGISTRY[name] = (fn, rules)
+        PASS_REGISTRY[name] = (fn, rules, scope)
         return fn
 
     return deco
 
 
-def run_passes(project: Project, only_rules: Optional[set] = None) -> list:
-    """Run every registered pass, apply suppressions, return findings
-    sorted by (path, line, rule). Unparseable files yield XF001."""
-    import xflow_tpu.analysis.passes  # noqa: F401  (registers passes)
-
+def _run_selected(project: Project, pass_names, only_rules: Optional[set],
+                  with_syntax: bool) -> list:
+    """Raw findings (no suppression/dedup) from the named passes."""
     findings: list[Finding] = []
-    sources = {m.relpath: m for m in project.modules}
-    sources.update({s.relpath: s for s in project.shell_scripts})
-    for mod in project.modules:
-        if mod.syntax_error is None:
-            continue
-        # XF001 honors --rules and suppressions like any other rule
-        # (the suppression table parses line-wise, so it exists even
-        # for files the AST parser rejected)
-        if only_rules is not None and "XF001" not in only_rules:
-            continue
-        if mod.suppressed("XF001", 1):
-            continue
-        findings.append(Finding(
-            rule="XF001", path=mod.relpath, line=1,
-            message=f"syntax error: {mod.syntax_error}",
-            hint="xflowlint needs parseable sources to analyze",
-        ))
-    for name, (runner, rules) in sorted(PASS_REGISTRY.items()):
+    if with_syntax:
+        for mod in project.modules:
+            if mod.syntax_error is None:
+                continue
+            # XF001 honors --rules like any other rule
+            if only_rules is not None and "XF001" not in only_rules:
+                continue
+            findings.append(Finding(
+                rule="XF001", path=mod.relpath, line=1,
+                message=f"syntax error: {mod.syntax_error}",
+                hint="xflowlint needs parseable sources to analyze",
+            ))
+    for name in sorted(pass_names):
+        runner, rules, _scope = PASS_REGISTRY[name]
         if only_rules is not None and not (set(rules) & only_rules):
             continue
         for f in runner(project):
             if only_rules is not None and f.rule not in only_rules:
                 continue
-            src = sources.get(f.path)
-            if src is not None and src.suppressed(f.rule, f.line):
-                continue
             findings.append(f)
-    # dedup: two passes (or one regex matching twice on a line) must
-    # not double-report one defect
+    return findings
+
+
+def _mp_worker(payload) -> list:
+    """Pool worker: lint one chunk of files with the module-scope
+    passes. Receives plain paths (ASTs don't pickle; re-parsing a chunk
+    is cheap) and returns raw findings."""
+    root, paths, pass_names, only = payload
+    import xflow_tpu.analysis.passes  # noqa: F401  (registers passes)
+
+    sub = Project.load(root, paths)
+    return _run_selected(sub, pass_names,
+                         set(only) if only is not None else None,
+                         with_syntax=True)
+
+
+def _run_parallel(project: Project, only_rules: Optional[set],
+                  jobs: int) -> list:
+    """Module-scope passes fan out over a fork pool (one chunk of files
+    per worker); project-scope passes run in-process on the full tree.
+    Output is merged raw findings — identical to the serial path after
+    the shared suppress/dedup/sort."""
+    import multiprocessing
+
+    module_passes = [n for n, (_f, _r, s) in PASS_REGISTRY.items()
+                     if s == "module"]
+    project_passes = [n for n, (_f, _r, s) in PASS_REGISTRY.items()
+                      if s == "project"]
+    paths = [m.path for m in project.modules] \
+        + [s.path for s in project.shell_scripts]
+    chunks = [c for c in (paths[i::jobs] for i in range(jobs)) if c]
+    only = sorted(only_rules) if only_rules is not None else None
+    payloads = [(project.root, c, module_passes, only) for c in chunks]
+    ctx = multiprocessing.get_context("fork")
+    with ctx.Pool(processes=len(chunks)) as pool:
+        # dispatch the workers FIRST, then run the project-scope passes
+        # while they execute: wall-clock is max(project, module), not
+        # the sum
+        async_result = pool.map_async(_mp_worker, payloads)
+        findings = _run_selected(project, project_passes, only_rules,
+                                 with_syntax=False)
+        for chunk_findings in async_result.get():
+            findings.extend(chunk_findings)
+    return findings
+
+
+def run_passes(project: Project, only_rules: Optional[set] = None,
+               jobs: int = 1) -> list:
+    """Run every registered pass, apply suppressions, return findings
+    sorted by (path, line, rule). Unparseable files yield XF001.
+    `jobs` > 1 fans the per-module passes out over a process pool
+    (same findings, same order — the pre-commit speed path); any pool
+    failure falls back to the serial sweep."""
+    import xflow_tpu.analysis.passes  # noqa: F401  (registers passes)
+
+    raw: list[Finding]
+    if jobs > 1 and len(project.modules) + len(project.shell_scripts) > 1:
+        try:
+            raw = _run_parallel(project, only_rules, jobs)
+        except Exception:  # pragma: no cover — pool/platform failure
+            raw = _run_selected(project, set(PASS_REGISTRY), only_rules,
+                                with_syntax=True)
+    else:
+        raw = _run_selected(project, set(PASS_REGISTRY), only_rules,
+                            with_syntax=True)
+    sources = {m.relpath: m for m in project.modules}
+    sources.update({s.relpath: s for s in project.shell_scripts})
+    findings = []
+    for f in raw:
+        src = sources.get(f.path)
+        if src is not None and src.suppressed(f.rule, f.line):
+            continue
+        findings.append(f)
+    # dedup: two passes (or one fixpoint sweep visiting a loop body
+    # twice) must not double-report one defect
     seen: set = set()
     unique = []
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule,

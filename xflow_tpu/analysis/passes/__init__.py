@@ -4,9 +4,11 @@ never breaks `import xflow_tpu.analysis`)."""
 
 from xflow_tpu.analysis.passes import (  # noqa: F401
     config_keys,
+    hostsync,
     jit_purity,
     lockset,
     recompile,
     schema_drift,
+    sharding_contract,
     shell,
 )

@@ -297,7 +297,7 @@ def _split_root(parts: list) -> tuple:
     return None, []
 
 
-@register_pass("config-cross-check", RULES)
+@register_pass("config-cross-check", RULES, scope="project")
 def run(project: Project) -> list:
     tree = ConfigTree.parse(project.config_path)
     if tree is None:

@@ -1,0 +1,273 @@
+"""XF11x host-sync taint: device-origin values blocking the hot path.
+
+docs/PERF.md's measured roofline names host/device synchronization as
+one of the two remaining perf levers, and the repo's answer is the
+one-step-behind discipline (telemetry.StepTimer / HealthMonitor,
+docs/OBSERVABILITY.md): a step's metrics are only read AFTER the next
+step's async dispatch, so the blocking read hides under device time
+instead of stalling it. These rules are that discipline's static
+complement, built on the flow-sensitive dataflow engine
+(analysis/dataflow.py):
+
+- XF110 explicit-host-sync: a device-origin value (the result of a jit
+  program call, `jax.device_put`, or a locally-jitted callable) flows
+  into a blocking host conversion — `float()`/`int()`/`np.asarray()`/
+  `.item()`/`.tolist()`/`.block_until_ready()`/`print`/`str.format`/
+  f-string interpolation — inside a hot loop, in the SAME iteration
+  that dispatched it (the value is still "fresh": no newer dispatch
+  has been issued to hide the block under).
+- XF111 implicit-host-sync: the same fresh device value driving a host
+  branch (`if`/`while`/ternary/`assert` test, `bool()`) or being
+  iterated — the sneakier form with no conversion call to grep for.
+
+Scope — the three hot paths, by qualified function name: the trainer's
+fit loop (`*._fit`), the input-pipeline prefetch producer
+(`prefetch`), and the serve device worker (`*._worker_loop`), plus
+their nested closures; and only sync sites inside a loop that
+DISPATCHES device work. Blocking between dispatches stalls the
+pipeline; a read-only loop (the post-fit occupancy sweep) performs
+mandatory one-time syncs and is exempt by construction.
+
+Exemption by construction, not suppression: the DELIBERATE one-behind
+reads never match, in three structural ways. (1) Freshness: a source
+call ages every device value in the environment, so a value staged
+last iteration and read after this iteration's dispatch is stale — the
+exact shape of the discipline. (2) The sanctioned blocking reads live
+in telemetry.py (StepTimer._finish_pending, HealthMonitor.collect),
+outside the scoped functions. (3) A closure reading staged metrics
+through a free variable (the trainer's `check_pending`) sees it as
+BOTTOM — crossing the staging seam is what makes the read legal, and
+it is also what makes it invisible to the intraprocedural engine.
+
+Motivating fix (this PR): the fit loop's log block read
+`float(m["loss"])` on the step it had JUST dispatched, stalling the
+device once per train.log_every steps; the record is now staged and
+written one step behind (train/trainer.py emit_pending_record).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from dataclasses import replace
+
+from xflow_tpu.analysis import astutil, dataflow
+from xflow_tpu.analysis.core import Finding, Project, register_pass
+
+RULES = ("XF110", "XF111")
+
+# the hot-path functions, by qualname pattern (nested closures included)
+HOT_QUALNAMES = (
+    "*._fit", "*._fit.*",
+    "*._worker_loop", "*._worker_loop.*",
+    "prefetch", "prefetch.*",
+)
+
+# callables whose results live on device: jit-program products bound as
+# attributes by the step builders (make_train_step / make_sharded_* /
+# make_predict_fn) — the names the trainer and serve tier call them by
+SOURCE_ATTRS = {"train_step", "eval_step", "predict_step", "_predict_step",
+                "step_fn"}
+SOURCE_CALLS = {"jax.device_put", "jax.jit", "jit", "pjit", "jax.pjit"}
+JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+# blocking host conversions (XF110). len() stays out on purpose: a jax
+# array's length is shape metadata, no device read
+SINK_CALLS = {
+    "float", "int", "bool", "str", "print",
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.float32", "numpy.float32", "np.float64", "numpy.float64",
+    "jax.device_get",
+}
+SINK_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _short(node) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover — unparse covers all exprs
+        s = "<expr>"
+    return s if len(s) <= 48 else s[:45] + "..."
+
+
+def _is_dispatch_call(node: ast.Call, aliases: dict,
+                      jitted_names: set) -> bool:
+    """Syntactic: does this call enqueue device work? (source-attr step
+    calls, device_put, an immediately-invoked jit, a locally-jitted
+    name)."""
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in SOURCE_ATTRS:
+        return True
+    if isinstance(node.func, ast.Name):
+        if node.func.id in SOURCE_ATTRS or node.func.id in jitted_names:
+            return True
+    cn = astutil.canonical(astutil.call_name(node), aliases)
+    if cn in SOURCE_CALLS and cn not in JIT_CTORS:
+        return True
+    if isinstance(node.func, ast.Call):  # jax.jit(f)(x)
+        inner = astutil.canonical(astutil.call_name(node.func), aliases)
+        return inner in JIT_CTORS
+    return False
+
+
+def _dispatching_loops(tree, aliases: dict) -> set:
+    """ids of loop nodes whose body issues a device dispatch. Only such
+    loops can have a sync BUBBLE: blocking between dispatches stalls
+    the pipeline, while a loop that only READS (a post-run epilogue
+    sweep) performs mandatory one-time syncs — exempt by construction."""
+    jitted_names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if astutil.canonical(astutil.call_name(node.value),
+                                 aliases) in JIT_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jitted_names.add(tgt.id)
+    out: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for sub in astutil.walk_scope(node):
+            if isinstance(sub, ast.Call) and _is_dispatch_call(
+                    sub, aliases, jitted_names):
+                out.add(id(node))
+                break
+    return out
+
+
+class _Hooks(dataflow.Hooks):
+    propagate_returns = True
+
+    def __init__(self, mod, parents, dispatch_loops):
+        self.mod = mod
+        self.parents = parents
+        self.dispatch_loops = dispatch_loops
+        self.findings: list = []
+
+    # ------------------------------------------------------------ helpers
+    def _in_scope(self, df) -> bool:
+        qn = df.current_qn
+        return bool(qn) and any(fnmatch.fnmatch(qn, p)
+                                for p in HOT_QUALNAMES)
+
+    def _hot(self, node, df) -> bool:
+        """Inside a hot function AND inside a loop that dispatches
+        device work — only there can a blocking read be a bubble."""
+        if not self._in_scope(df):
+            return False
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)) \
+                    and id(cur) in self.dispatch_loops:
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            cur = self.parents.get(cur)
+        return False
+
+    def _fresh_device(self, val) -> bool:
+        return val.tagged("device") and val.fresh
+
+    def _age(self, env: dict) -> None:
+        """A new device dispatch: every older device value's blocking
+        read now hides under it (fresh -> stale), containers included."""
+        for k, v in list(env.items()):
+            env[k] = self._aged(v)
+
+    def _aged(self, v):
+        if v.elems is not None:
+            v = replace(v, elems=tuple(self._aged(e) for e in v.elems))
+        if v.fresh:
+            v = replace(v, fresh=False)
+        return v
+
+    def _flag(self, rule: str, node, how: str, expr_node) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.mod.relpath, line=node.lineno,
+            message=(
+                f"{how} `{_short(expr_node)}` blocks on a device value "
+                "dispatched THIS iteration of the hot loop — a host/"
+                "device sync bubble (the one-step-behind discipline, "
+                "docs/OBSERVABILITY.md)"
+            ),
+            hint="stage the value and read it AFTER the next step's "
+                 "async dispatch (telemetry.StepTimer pattern), or move "
+                 "the read out of the loop",
+        ))
+
+    # -------------------------------------------------------------- hooks
+    def at_call(self, node, callee, argvals, kwvals, env, df, fval):
+        # -- sources: device dispatch ages the env, result is fresh
+        is_source = False
+        if callee in SOURCE_CALLS:
+            is_source = True
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in SOURCE_ATTRS:
+            is_source = True
+        elif isinstance(node.func, ast.Name) and node.func.id in SOURCE_ATTRS:
+            is_source = True
+        elif fval.ref is not None and fval.ref[0] == "jit":
+            is_source = True  # a name bound from jax.jit(...), invoked
+        if is_source:
+            if callee in JIT_CTORS:
+                # jax.jit(f) CONSTRUCTS a callable — no device dispatch
+                # happens, so nothing ages; invoking the returned ref
+                # later is the source
+                return dataflow.AbsVal(ref=("jit", id(node)),
+                                       origin=node.lineno)
+            self._age(env)
+            return dataflow.AbsVal(tags=frozenset({"device"}), fresh=True,
+                                   origin=node.lineno)
+        # -- sinks: explicit blocking conversions (XF110)
+        if callee in SINK_CALLS and self._hot(node, df):
+            for av, anode in zip(argvals, node.args):
+                if self._fresh_device(av):
+                    self._flag("XF110", node,
+                               f"blocking host sync `{callee}(...)` on",
+                               anode)
+                    break
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in SINK_METHODS and self._fresh_device(fval) \
+                    and self._hot(node, df):
+                self._flag("XF110", node,
+                           f"blocking host sync `.{node.func.attr}()` on",
+                           node.func.value)
+            elif node.func.attr == "format" and self._hot(node, df):
+                for av, anode in zip(argvals, node.args):
+                    if self._fresh_device(av):
+                        self._flag("XF110", node, "string formatting of",
+                                   anode)
+                        break
+        return None
+
+    def at_branch(self, node, val, env, df):
+        if self._fresh_device(val) and self._hot(node, df):
+            self._flag("XF111", node, "host branch condition on", node)
+
+    def at_iter(self, node, val, env, df):
+        if self._fresh_device(val) and self._hot(node, df):
+            self._flag("XF111", node, "host iteration over", node)
+
+    def at_format(self, node, val, env, df):
+        if self._fresh_device(val) and self._hot(node, df):
+            self._flag("XF110", node, "f-string interpolation of",
+                       node.value)
+
+
+@register_pass("host-sync", RULES)
+def run(project: Project) -> list:
+    findings: list = []
+    for mod in project.modules:
+        if mod.tree is None:
+            continue
+        defs = astutil.func_defs(mod.tree)
+        if not any(fnmatch.fnmatch(qn, p) for qn, _n, _c in defs
+                   for p in HOT_QUALNAMES):
+            continue
+        parents = astutil.parent_map(mod.tree)
+        aliases = astutil.import_aliases(mod.tree)
+        hooks = _Hooks(mod, parents, _dispatching_loops(mod.tree, aliases))
+        dataflow.Dataflow(mod, hooks).run_all()
+        findings.extend(hooks.findings)
+    return findings

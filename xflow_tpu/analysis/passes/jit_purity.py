@@ -93,54 +93,15 @@ def _is_jit_decorator(dec: ast.AST, aliases: dict) -> bool:
     return False
 
 
-def _resolve(simple: str, caller_qn: str, by_name: dict) -> list:
-    """Scope-aware name resolution: among same-named definitions, pick
-    the ones whose defining scope is an ancestor of the caller's scope,
-    preferring the innermost (two `def one(...)` in different functions
-    must never cross-link — that is how a host helper would get marked
-    jit-reachable). Falls back to every candidate for `self.x` refs."""
-    cands = by_name.get(simple, [])
-    if len(cands) <= 1:
-        return list(cands)
-    visible = []
-    for c in cands:
-        scope = c.rsplit(".", 1)[0] if "." in c else ""
-        if scope == "" or caller_qn == scope or caller_qn.startswith(
-                scope + "."):
-            visible.append((len(scope.split(".")) if scope else 0, c))
-    if not visible:
-        return list(cands)
-    best = max(d for d, _c in visible)
-    return [c for d, c in visible if d == best]
-
-
-def _scope_sites(tree: ast.AST, defs: list):
-    """Yields (caller qualname, node) for every node, attributed to its
-    innermost enclosing function ('' = module level)."""
-    covered: dict = {}
-    for qn, node, _cls in defs:
-        for sub in astutil.walk_scope(node):
-            covered.setdefault(id(sub), (qn, sub))
-    # module-level statements (not inside any def)
-    seen_ids = set(covered)
-    for node in ast.walk(tree):
-        if id(node) not in seen_ids and not isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            covered.setdefault(id(node), ("", node))
-    return covered.values()
-
-
 def _jit_roots(tree: ast.AST, defs: list, aliases: dict) -> tuple:
     """(root qualnames, lambda nodes traced directly)."""
-    by_name: dict = {}
-    for qn, node, _cls in defs:
-        by_name.setdefault(qn.split(".")[-1], []).append(qn)
+    by_name = astutil.defs_by_name(defs)
     roots: set = set()
     lambdas: list = []
     for qn, node, _cls in defs:
         if any(_is_jit_decorator(d, aliases) for d in node.decorator_list):
             roots.add(qn)
-    for caller_qn, node in _scope_sites(tree, defs):
+    for caller_qn, node in astutil.scope_sites(tree, defs):
         if not isinstance(node, ast.Call):
             continue
         cn = astutil.canonical(astutil.call_name(node), aliases)
@@ -150,47 +111,15 @@ def _jit_roots(tree: ast.AST, defs: list, aliases: dict) -> tuple:
             continue
         for arg in list(node.args) + [kw.value for kw in node.keywords]:
             if isinstance(arg, ast.Name):
-                roots.update(_resolve(arg.id, caller_qn, by_name))
+                roots.update(astutil.resolve_scoped(arg.id, caller_qn,
+                                                    by_name))
             elif isinstance(arg, ast.Lambda):
                 lambdas.append(arg)
             elif isinstance(arg, ast.Attribute):
                 # self.step / cls.step — match by trailing attribute
-                roots.update(_resolve(arg.attr, caller_qn, by_name))
+                roots.update(astutil.resolve_scoped(arg.attr, caller_qn,
+                                                    by_name))
     return roots, lambdas
-
-
-def _call_graph(defs: list) -> dict:
-    """qualname -> set of callee qualnames (module-local, scope-aware:
-    a call binds to the innermost visible same-named definition)."""
-    by_name: dict = {}
-    for qn, node, _cls in defs:
-        by_name.setdefault(qn.split(".")[-1], []).append(qn)
-    graph: dict = {}
-    for qn, node, _cls in defs:
-        callees: set = set()
-        for sub in astutil.walk_scope(node):
-            if not isinstance(sub, ast.Call):
-                continue
-            cn = astutil.call_name(sub)
-            if cn is None:
-                continue
-            simple = cn.split(".")[-1]
-            if cn == simple or cn == f"self.{simple}" or cn == f"cls.{simple}":
-                callees.update(_resolve(simple, qn, by_name))
-        graph[qn] = callees
-    return graph
-
-
-def _reachable(roots: set, graph: dict) -> set:
-    seen = set(roots)
-    stack = list(roots)
-    while stack:
-        cur = stack.pop()
-        for nxt in graph.get(cur, ()):
-            if nxt not in seen:
-                seen.add(nxt)
-                stack.append(nxt)
-    return seen
 
 
 def _scan_body(body_owner: ast.AST, relpath: str, where: str,
@@ -240,8 +169,8 @@ def run(project: Project) -> list:
         roots, lambdas = _jit_roots(mod.tree, defs, aliases)
         if not roots and not lambdas:
             continue
-        graph = _call_graph(defs)
-        reach = _reachable(roots, graph)
+        graph = astutil.local_call_graph(defs)
+        reach = astutil.reachable(roots, graph)
         by_qn = {qn: node for qn, node, _cls in defs}
         for qn in sorted(reach):
             node = by_qn.get(qn)

@@ -12,7 +12,14 @@ the same class of bug before the code ever runs:
   `static_argnums`/`static_argnames` recompiles once per DISTINCT
   value of each static argument. Passing a loop induction variable, or
   different literals across call sites, in a static slot is a
-  compile-per-step bug.
+  compile-per-step bug. Loop-variable detection rides the
+  flow-sensitive dataflow engine (analysis/dataflow.py): a value is
+  flagged only when it still varies with a loop ENCLOSING the call
+  site — a loop variable read after its loop (one value per outer
+  execution), or a name rebound to a constant inside the loop, no
+  longer false-fires, and a value copied OFF the induction variable
+  (`n = k; g(1.0, n)`) is now caught. This removed the pass's old
+  scope-locality precision caveats.
 - XF203 unhashable-static-argument: a list/dict/set literal in a
   static slot raises (static args are cache keys and must hash) — at
   call time, far from the jit site that declared it static.
@@ -26,7 +33,7 @@ the same class of bug before the code ever runs:
 from __future__ import annotations
 
 import ast
-from xflow_tpu.analysis import astutil
+from xflow_tpu.analysis import astutil, dataflow
 from xflow_tpu.analysis.core import Finding, Project, register_pass
 
 RULES = ("XF201", "XF202", "XF203", "XF204")
@@ -66,25 +73,38 @@ def _static_spec(call: ast.Call) -> tuple:
     return nums, names
 
 
-def _loop_vars_for(node: ast.AST, parents: dict) -> set:
-    """Names bound as for-loop targets in the SAME scope as `node`
-    (its enclosing function, or the module top level) — a parameter
-    sharing a name with an unrelated loop variable in some other
-    function must not read as a loop variable here."""
-    owner = astutil.enclosing(
-        node, parents, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
-    if owner is None:
-        # module scope: walk up to the root
-        owner = node
-        while parents.get(owner) is not None:
-            owner = parents[owner]
-    out: set = set()
-    for sub in astutil.walk_scope(owner):
-        if isinstance(sub, (ast.For, ast.AsyncFor)):
-            for t in ast.walk(sub.target):
-                if isinstance(t, ast.Name):
-                    out.add(t.id)
-    return out
+class _StaticSlotHooks(dataflow.Hooks):
+    """Dataflow hooks recording the abstract value of every static-slot
+    argument at every call site of a statically-jitted name. The
+    flow-sensitive loop-variance fact replaces the old name-set
+    heuristic (see module docstring: XF202 retrofit)."""
+
+    def __init__(self, jitted_specs: dict):
+        self.jitted_specs = jitted_specs  # fname -> (nums, names)
+        # (id(call), slot) -> [call node, arg node, joined AbsVal]
+        self.sites: dict = {}
+
+    def _record(self, call, slot, arg_node, val) -> None:
+        key = (id(call), slot)
+        cur = self.sites.get(key)
+        if cur is None:
+            self.sites[key] = [call, arg_node, val]
+        else:
+            cur[2] = dataflow.join(cur[2], val)
+
+    def at_call(self, node, callee, argvals, kwvals, env, df, fval):
+        fname = astutil.dotted(node.func)
+        spec = self.jitted_specs.get(fname)
+        if spec is None:
+            return None
+        nums, names = spec
+        for idx in nums:
+            if idx < len(node.args):
+                self._record(node, idx, node.args[idx], argvals[idx])
+        for kw in node.keywords:
+            if kw.arg in names and kw.arg in kwvals:
+                self._record(node, kw.arg, kw.value, kwvals[kw.arg])
+        return None
 
 
 @register_pass("recompile-hazard", RULES)
@@ -211,33 +231,75 @@ def run(project: Project) -> list:
                              " when a recorder is configured",
                     ))
 
-        # ---- XF202/XF203: call sites of statically-jitted names -------
+        # ---- XF203: unhashable literals in static slots (syntactic) ---
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
             fname = astutil.dotted(node.func)
             if fname not in jitted:
                 continue
-            jcall = jitted[fname]
-            nums, names = _static_spec(jcall)
+            nums, names = _static_spec(jitted[fname])
             if not nums and not names:
                 continue
-            loop_vars = _loop_vars_for(node, parents)
             for idx in nums:
                 if idx < len(node.args):
-                    arg = node.args[idx]
-                    _check_static_arg(findings, mod, node, fname, idx, arg,
-                                      loop_vars)
+                    _check_unhashable(findings, mod, node, fname, idx,
+                                      node.args[idx])
             for kw in node.keywords:
                 if kw.arg in names:
-                    _check_static_arg(findings, mod, node, fname, kw.arg,
-                                      kw.value, loop_vars)
+                    _check_unhashable(findings, mod, node, fname, kw.arg,
+                                      kw.value)
+        # ---- XF202 (loop variance): flow-sensitive dataflow sweep -----
+        specs = {}
+        for fname, jcall in jitted.items():
+            nums, names = _static_spec(jcall)
+            if nums or names:
+                specs[fname] = (nums, names)
+        if specs:
+            hooks = _StaticSlotHooks(specs)
+            dataflow.Dataflow(mod, hooks).run_all()
+            for (_cid, slot), (call, arg_node, val) in sorted(
+                    hooks.sites.items(),
+                    key=lambda kv: (kv[1][0].lineno, str(kv[0][1]))):
+                if not val.tagged("loopvar"):
+                    continue
+                # the value must still VARY here: some loop that bound
+                # it must enclose this call site (a loop variable read
+                # after its loop is one value per outer execution)
+                if not _inside_binding_loop(call, val.loops, parents):
+                    continue
+                fname = astutil.dotted(call.func)
+                label = arg_node.id if isinstance(arg_node, ast.Name) \
+                    else "<derived from a loop variable>"
+                findings.append(Finding(
+                    rule="XF202", path=mod.relpath, line=call.lineno,
+                    message=f"loop variable `{label}` in static slot "
+                            f"{slot!r} of jitted `{fname}` — recompiles "
+                            "once per loop value",
+                    hint="make the argument dynamic (traced) or hoist "
+                         "the loop into the program (lax.scan / "
+                         "fori_loop)",
+                ))
         # cross-site varying literals in static slots
         _varying_literals(findings, mod, jitted)
     return findings
 
 
-def _check_static_arg(findings, mod, call, fname, slot, arg, loop_vars):
+def _inside_binding_loop(call: ast.AST, loop_ids: frozenset,
+                         parents: dict) -> bool:
+    cur = parents.get(call)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)) \
+                and id(cur) in loop_ids:
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+def _check_unhashable(findings, mod, call, fname, slot, arg):
     if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
         findings.append(Finding(
             rule="XF203", path=mod.relpath, line=call.lineno,
@@ -246,14 +308,6 @@ def _check_static_arg(findings, mod, call, fname, slot, arg, loop_vars):
                     "args are cache keys and must hash",
             hint="pass a tuple (or hoist the structure out of the static "
                  "signature)",
-        ))
-    elif isinstance(arg, ast.Name) and arg.id in loop_vars:
-        findings.append(Finding(
-            rule="XF202", path=mod.relpath, line=call.lineno,
-            message=f"loop variable `{arg.id}` in static slot {slot!r} of "
-                    f"jitted `{fname}` — recompiles once per loop value",
-            hint="make the argument dynamic (traced) or hoist the loop "
-                 "into the program (lax.scan / fori_loop)",
         ))
 
 

@@ -963,6 +963,69 @@ class Trainer:
         bad_run = 0  # consecutive discarded steps
         halted = False
         pending_ok = None  # (metrics, step index) awaiting the flag check
+        pending_rec = None  # a log-cadence step's payload, written one behind
+
+        def emit_pending_record() -> None:
+            """Write the staged metrics-JSONL record for the last
+            log-cadence step. Called right after the NEXT step's
+            dispatch (or the end-of-data flush) has block_until_ready'd
+            the staged step's metrics, so every float() here is a
+            ready-buffer host copy — never a device sync. Reading the
+            loss at staging time instead stalled the device once per
+            train.log_every steps (the XF110 sync-bubble class; same
+            one-step-behind discipline as telemetry.StepTimer)."""
+            nonlocal pending_rec
+            if pending_rec is None:
+                return
+            pm, at_step, at_epoch, at_examples, at_elapsed, counters = \
+                pending_rec
+            pending_rec = None
+            loss = float(pm["loss"])
+            # under the guard a bad step's NaN loss belongs to a
+            # DISCARDED update: last_loss tracks the last loss that
+            # actually trained in, and the JSONL record stays
+            # strict-JSON (None, not a bare NaN literal)
+            finite = loss == loss and abs(loss) != float("inf")
+            if finite or not self._guarded:
+                res.last_loss = loss
+            # step/examples/elapsed_s/counters were all captured at the
+            # staging step (host-only reads — no sync), so every
+            # rate a consumer derives from them (pipeline_attrib's
+            # e2e_examples_per_sec, host_gap_ratio) stays internally
+            # consistent; only the device-value reads wait for the
+            # one-behind block
+            rec = {
+                "step": at_step,
+                "epoch": at_epoch,
+                "loss": loss if finite else None,
+                "examples": at_examples,
+                "elapsed_s": at_elapsed,
+            }
+            # window stats: rows/s, steps/s, p50/p99 step time,
+            # data-wait/dispatch/device decomposition (telemetry.
+            # StepTimer) — emitted one step behind, the window now
+            # covers exactly the cadence's finished steps — plus the
+            # measured roofline gauges when the compile recorder knows
+            # the step's cost
+            rec.update(steptimer.window_record(cost=self._step_cost()))
+            # live HBM gauges (guarded: CPU allocators report nothing
+            # and the fields simply stay out)
+            rec.update(hbm_window_fields(registry))
+            # health window: norms, loss EMA, occupancy / collision
+            # gauges (one behind, like the timer)
+            rec.update(health.window_record())
+            if counters:
+                rec["counters"] = counters
+            self.metrics.log(rec)
+            if prof is not None:
+                # the pipeline window rides the same log cadence as its
+                # OWN kind="pipeline" record (schema: docs/
+                # OBSERVABILITY.md "Input-pipeline attribution")
+                prec = prof.window_record()
+                if prec:
+                    self.metrics.log(
+                        {"kind": "pipeline", "step": at_step, **prec}
+                    )
 
         def check_pending() -> bool:
             """Consume the PREVIOUS step's update_ok flag. Called right
@@ -1143,6 +1206,10 @@ class Trainer:
                     # health scalars (norms, loss for the EMA) read free
                     health.collect()
                     health.staged(m)
+                    # ... and so is the previous log-cadence step's
+                    # staged record: its reads hide under THIS step's
+                    # device time (one-behind discipline, XF110)
+                    emit_pending_record()
                     hang.tick()
                     last_metrics = m
                     res.steps += 1
@@ -1173,54 +1240,31 @@ class Trainer:
                     if self._guarded:
                         pending_ok = (m, res.steps)
                     if cfg.train.log_every and res.steps % cfg.train.log_every == 0:
-                        loss = float(m["loss"])
-                        # under the guard a bad step's NaN loss belongs to a
-                        # DISCARDED update: last_loss tracks the last loss
-                        # that actually trained in, and the JSONL record
-                        # stays strict-JSON (None, not a bare NaN literal)
-                        finite = loss == loss and abs(loss) != float("inf")
-                        if finite or not self._guarded:
-                            res.last_loss = loss
-                        rec = {
-                            "step": res.steps,
-                            "epoch": epoch,
-                            "loss": loss if finite else None,
-                            "examples": res.examples,
-                            "elapsed_s": round(time.perf_counter() - start, 3),
-                        }
-                        # window stats: rows/s, steps/s, p50/p99 step
-                        # time, data-wait/dispatch/device decomposition
-                        # (telemetry.StepTimer; empty only at step 1
-                        # under log_every=1 — timing runs one behind),
-                        # plus the measured roofline gauges when the
-                        # compile recorder knows the step's cost
-                        rec.update(steptimer.window_record(cost=self._step_cost()))
-                        # live HBM gauges (guarded: CPU allocators
-                        # report nothing and the fields simply stay out)
-                        rec.update(hbm_window_fields(registry))
-                        # health window: norms, loss EMA, occupancy /
-                        # collision gauges (one behind, like the timer)
-                        rec.update(health.window_record())
-                        counters = registry.snapshot()
-                        if counters:
-                            rec["counters"] = counters
-                        self.metrics.log(rec)
-                        if prof is not None:
-                            # the pipeline window rides the same log
-                            # cadence as its OWN kind="pipeline" record
-                            # (schema: docs/OBSERVABILITY.md
-                            # "Input-pipeline attribution")
-                            prec = prof.window_record()
-                            if prec:
-                                self.metrics.log(
-                                    {"kind": "pipeline", "step": res.steps,
-                                     **prec}
-                                )
+                        # stage, don't read: float(m["loss"]) here would
+                        # block on the step JUST dispatched — the exact
+                        # sync bubble XF110 exists to catch. The record
+                        # is written next iteration (or at the end-of-
+                        # data flush), when the one-behind block has
+                        # already made its reads free. elapsed_s and the
+                        # counter snapshot are host-only and captured
+                        # NOW so they pair with this step's examples.
+                        pending_rec = (
+                            m, res.steps, epoch, res.examples,
+                            round(time.perf_counter() - start, 3),
+                            registry.snapshot(),
+                        )
                     if (
                         cfg.train.checkpoint_dir
                         and cfg.train.checkpoint_every
                         and res.steps % cfg.train.checkpoint_every == 0
                     ):
+                        # a record staged THIS step must be durable
+                        # before the kill window a checkpoint boundary
+                        # opens (the elastic drills SIGKILL right after
+                        # the save — SIGKILL bypasses every salvage
+                        # net); the save below is itself a full state
+                        # sync, so these reads hide under it
+                        emit_pending_record()
                         # bracket the (possibly minutes-long collective)
                         # save with beats: no train step completes inside
                         # it, and under a supervised launch a false dead
@@ -1330,6 +1374,11 @@ class Trainer:
             if not halted and check_pending():
                 halted = True
             if halted:
+                # a record staged on the halting step is the run's most
+                # diagnostic line — write it before aborting (the abort
+                # path can afford its one sync; the eager pre-XF110
+                # code always wrote it)
+                emit_pending_record()
                 self.metrics.log(
                     {
                         "nonfinite_halt": True,
@@ -1360,6 +1409,19 @@ class Trainer:
                 # state never took the bad update)
                 if (loss == loss and abs(loss) != float("inf")) or not self._guarded:
                     res.last_loss = loss
+        except BaseException:
+            # ANY crash between staging and the next emit (quarantine
+            # exhaustion, a checkpoint IOError, SIGINT) must not lose
+            # the staged log record — before the XF110 staging it was
+            # already on disk, and it is the line that explains the
+            # crash. Never let a failing emit mask the real exception —
+            # not even a second Ctrl+C while the salvage read blocks on
+            # a wedged device (hence BaseException here too).
+            try:
+                emit_pending_record()
+            except BaseException:
+                pass
+            raise
         finally:
             sig_restore()
             dump_restore()
@@ -1371,12 +1433,17 @@ class Trainer:
         if prof is None:
             steptimer.flush()
             health.flush()
+            # a record staged on the run's final step has no successor
+            # dispatch to hide behind; the flush above just paid its
+            # one end-of-data sync, so these reads are free too
+            emit_pending_record()
         else:
             t0 = time.perf_counter()
             steptimer.flush()
             health.flush()
             # the last step's metrics block belongs to its device stage
             prof.add("device", time.perf_counter() - t0)
+            emit_pending_record()  # consumes the tail pipeline window too
             prec = prof.window_record()
             if prec:
                 # the tail pipeline window, BEFORE the occupancy sweep
