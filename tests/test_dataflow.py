@@ -343,3 +343,206 @@ def test_module_level_statements_are_analyzed():
     h = run("x = make()\nsink(x)\n")
     (_ln, val), = h.sinks
     assert val.tagged("device")
+
+
+# -------------------------------------- while loops: explicit fixpoints
+
+
+def test_while_fixpoint_carries_back_edge_bindings():
+    """A value bound at the END of a while body must be visible at the
+    TOP of the next iteration (the back edge): the fixpoint's second
+    pass joins it in. The engine's loop handling was written for `for`;
+    this pins that `while` gets the same treatment."""
+    h = run(
+        "def f(c):\n"
+        "    x = 1\n"
+        "    while c:\n"
+        "        sink(x)\n"
+        "        x = make()\n"
+    )
+    (_ln, val), = h.sinks
+    # joined across iterations: host on the first pass, device on the
+    # back edge -> may-device
+    assert val.tagged("device")
+
+
+def test_while_one_behind_aging_matches_for_loop():
+    """The XF110 exempt/fire split inside a while loop: the value made
+    THIS iteration is fresh; the one staged LAST iteration was aged by
+    the newer dispatch."""
+    h = run(
+        "def f(c):\n"
+        "    staged = None\n"
+        "    while c:\n"
+        "        m = make()\n"
+        "        sink(m)\n"
+        "        sink(staged)\n"
+        "        staged = m\n"
+    )
+    by_line = dict(h.sinks)
+    assert by_line[5].tagged("device") and by_line[5].fresh
+    assert by_line[6].tagged("device") and not by_line[6].fresh
+
+
+def test_while_test_expression_is_evaluated_each_pass():
+    """The while TEST is part of the loop body for hook purposes (the
+    XF111 implicit-sync rule needs branch hooks on it) and must see the
+    back-edge bindings."""
+    class H(TaintHooks):
+        def __init__(self):
+            super().__init__()
+            self.branches = []
+
+        def at_branch(self, node, val, env, df):
+            self.branches.append(val)
+
+    h = run(
+        "def f(b):\n"
+        "    ok = True\n"
+        "    while ok:\n"
+        "        ok = make()\n",
+        H(),
+    )
+    assert any(v.tagged("device") for v in h.branches)
+
+
+def test_while_orelse_runs_after_fixpoint():
+    h = run(
+        "def f(c):\n"
+        "    x = 1\n"
+        "    while c:\n"
+        "        x = make()\n"
+        "    else:\n"
+        "        sink(x)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device")
+
+
+# -------------------------- comprehension / generator scope + variance
+
+
+def test_comprehension_target_is_loop_variant():
+    """A comprehension target varies per iteration exactly like a
+    for-loop target: tagged loopvar, bound to the comprehension node
+    (the XF202 enclosure check accepts comprehensions)."""
+    h = run(
+        "def f(xs):\n"
+        "    ys = [sink(k) for k in xs]\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("loopvar") and val.loops
+
+
+def test_generator_target_is_loop_variant():
+    h = run(
+        "def f(xs):\n"
+        "    ys = list(sink(k) for k in xs)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("loopvar") and val.loops
+
+
+def test_comprehension_binding_does_not_leak_or_clobber():
+    """Python gives comprehensions their own scope: the target must
+    neither leak into the enclosing scope nor clobber a same-named
+    outer binding."""
+    h = run(
+        "def f(xs):\n"
+        "    k = make()\n"
+        "    ys = [k + 1 for k in xs]\n"
+        "    sink(k)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device") and not val.tagged("loopvar")
+
+
+def test_comprehension_iter_taint_reaches_target():
+    """Iterating a device-tainted container taints the per-element
+    target (same may-semantics as the for-loop binding)."""
+    h = run(
+        "def f(b):\n"
+        "    ms = make()\n"
+        "    return [sink(m) for m in ms]\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device") and val.tagged("loopvar")
+
+
+def test_nested_comprehension_generators_chain():
+    h = run(
+        "def f(xss):\n"
+        "    return [sink(x) for xs in xss for x in xs]\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("loopvar")
+
+
+# ------------------------------------ try/except join semantics, pinned
+
+
+def test_except_handler_sees_may_bindings_from_try_body():
+    """The handler can run after ANY prefix of the try body: a binding
+    made in the body must reach it as a may-fact (joined with the
+    pre-state)."""
+    h = run(
+        "def f(b):\n"
+        "    x = 1\n"
+        "    try:\n"
+        "        x = make()\n"
+        "        risky()\n"
+        "    except ValueError:\n"
+        "        sink(x)\n"
+    )
+    (_ln, val), = h.sinks
+    assert val.tagged("device")
+
+
+def test_try_else_not_polluted_by_handler_bindings():
+    """The else block runs only when NO exception fired: a handler's
+    binding must not leak into it."""
+    h = run(
+        "def f(b):\n"
+        "    x = 1\n"
+        "    try:\n"
+        "        x = 2\n"
+        "    except Exception:\n"
+        "        x = make()\n"
+        "    else:\n"
+        "        sink(x)\n"
+    )
+    (_ln, val), = h.sinks
+    assert not val.tagged("device")
+
+
+def test_finally_joins_body_and_handler_paths():
+    """finally runs on every path: it must see the join of the body's
+    and every handler's bindings, and its own bindings must survive
+    into the fall-through environment."""
+    h = run(
+        "def f(b):\n"
+        "    x = 1\n"
+        "    try:\n"
+        "        x = make()\n"
+        "    except Exception:\n"
+        "        x = 2\n"
+        "    finally:\n"
+        "        sink(x)\n"
+        "        y = make()\n"
+        "    sink(y)\n"
+    )
+    by_line = dict(h.sinks)
+    assert by_line[8].tagged("device")  # may: device on the try path
+    assert by_line[10].tagged("device")  # finally bindings fall through
+
+
+def test_handler_exception_name_is_bottom():
+    h = run(
+        "def f(b):\n"
+        "    try:\n"
+        "        x = make()\n"
+        "    except Exception as e:\n"
+        "        sink(e)\n"
+    )
+    (_ln, val), = h.sinks
+    assert not val.tagged("device")

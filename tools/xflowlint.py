@@ -2,24 +2,32 @@
 """xflowlint — project-native static analysis for xflow-tpu.
 
 Runs the xflow_tpu/analysis passes (docs/STATIC_ANALYSIS.md) over the
-repo (or explicit paths) and gates against the checked-in baseline:
+repo (or explicit paths) and gates against the checked-in baseline.
+Full-tree runs also run the IR tier (analysis/ir.py): the engine
+builders' jitted programs are lowered to jaxprs in a pinned CPU
+subprocess (trace-only, no execution) and checked semantically
+(XF801–XF804); where jax (or an importable tree) is absent the IR tier
+degrades to a notice and every AST-tier rule still runs.
 
     python tools/xflowlint.py                       # full repo, baselined
-    python tools/xflowlint.py xflow_tpu/serve       # subset (no dead-key)
+    python tools/xflowlint.py xflow_tpu/serve       # subset (AST tier only)
     python tools/xflowlint.py --rules XF301         # one rule family
     python tools/xflowlint.py --changed -j 8        # pre-commit fast path
-    python tools/xflowlint.py --write-baseline      # re-record legacy set
+    python tools/xflowlint.py --write-baseline --reason "..."
     python tools/xflowlint.py --check-contracts     # engine-contract gate
+    python tools/xflowlint.py --check-worklist      # fusion-worklist gate
     python tools/xflowlint.py --list-rules
 
 Exit codes (tools/smoke_lint.sh relies on these):
     0  clean — no unbaselined findings, no stale baseline entries
     1  NEW findings (not in the baseline)
     2  STALE baseline entries (a fixed finding must leave the baseline)
-    3  usage / internal error
-    4  CONTRACT drift — the extracted engine-contract matrix differs
-       from the checked-in tools/engine_contracts.json (regenerate
-       with --write-contracts and review the diff)
+    3  usage / internal error (incl. a baseline entry still carrying
+       the "TODO: justify or fix" placeholder reason)
+    4  ARTIFACT drift — the extracted engine-contract matrix differs
+       from tools/engine_contracts.json, or the extracted fusion
+       worklist differs from tools/fusion_worklist.json (regenerate
+       with --write-contracts / --write-worklist and review the diff)
 
 The baseline (tools/xflowlint_baseline.json) makes the gate fail on
 *growth*, not existence; inline `# xflowlint: disable=RULE` handles
@@ -37,10 +45,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from xflow_tpu.analysis.core import (  # noqa: E402
-    PASS_REGISTRY, Baseline, Project, run_passes,
+    IR_RULES, PASS_REGISTRY, Baseline, Finding, Project, run_passes,
 )
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "xflowlint_baseline.json")
+REASON_PLACEHOLDER = "TODO: justify or fix"
 
 
 def _changed_paths(root: str) -> list:
@@ -85,15 +94,60 @@ def _contract_artifact_path(root: str) -> str:
     return os.path.join(root, "tools", "engine_contracts.json")
 
 
+def _worklist_artifact_path(root: str) -> str:
+    from xflow_tpu.analysis.passes.ir_rules import WORKLIST_REL
+
+    return os.path.join(root, *WORKLIST_REL.split("/"))
+
+
+def _ir_facts_or_notice(root: str, no_ir: bool):
+    """-> facts dict or None (with the skip notice already printed)."""
+    if no_ir:
+        print("xflowlint: IR tier disabled (--no-ir)", file=sys.stderr)
+        return None
+    from xflow_tpu.analysis.passes.ir_rules import ir_facts
+
+    facts, reason = ir_facts(root)
+    if facts is None:
+        print(f"xflowlint: NOTICE — IR tier skipped ({reason}); "
+              "AST-tier results only", file=sys.stderr)
+    return facts
+
+
+def _artifact_drift(kind: str, path: str, on_disk: str, rendered: str,
+                    regen_flag: str) -> int:
+    import difflib
+
+    diff = difflib.unified_diff(
+        on_disk.splitlines(), rendered.splitlines(),
+        fromfile="checked-in", tofile="extracted", lineterm="", n=2)
+    lines = list(diff)[:40]
+    print(f"xflowlint: {kind} DRIFT — the extracted artifact differs "
+          f"from {path}:", file=sys.stderr)
+    for ln in lines:
+        print(f"  {ln}", file=sys.stderr)
+    print(f"xflowlint: if the change is intended, regenerate with "
+          f"`python tools/xflowlint.py {regen_flag}` and review the "
+          "diff (it is a machine-checked acceptance oracle)",
+          file=sys.stderr)
+    return 4
+
+
 def _contracts_mode(args, write: bool) -> int:
     """--write-contracts / --check-contracts: the engine-contract
-    matrix gate (docs/DISTRIBUTED.md "Engine contract matrix")."""
+    matrix gate (docs/DISTRIBUTED.md "Engine contract matrix"). v2:
+    the matrix carries a per-program jaxpr section (op histogram,
+    gather/scatter counts, dtype census, flop/byte estimates) from the
+    IR tier; where the IR tier is unavailable the section is preserved
+    (write) or excluded from the comparison (check), with a notice."""
+    from xflow_tpu.analysis.passes.ir_rules import ir_contract_section
     from xflow_tpu.analysis.passes.sharding_contract import (
         ENGINE_MODULES, MESH_MODULE, extract_contracts, render_artifact,
     )
 
-    # only the builder sources (+ the mesh axis anchor) feed the matrix
-    # — loading them alone keeps the pre-commit contract check cheap
+    # only the builder sources (+ the mesh axis anchor) feed the AST
+    # matrix — loading them alone keeps the pre-commit contract check
+    # cheap (the IR tier imports the real modules in its own process)
     wanted = [os.path.join(args.root, *rel.split("/"))
               for rel in ENGINE_MODULES + (MESH_MODULE,)]
     project = Project.load(args.root,
@@ -105,41 +159,112 @@ def _contracts_mode(args, write: bool) -> int:
             "xflowlint: engine builders missing from the source tree: "
             + ", ".join(missing), file=sys.stderr)
         return 3
-    rendered = render_artifact(contracts)
+    facts = _ir_facts_or_notice(args.root, args.no_ir)
+    ir_ok = facts is not None
+    if ir_ok and facts.get("errors"):
+        # a program that failed to lower would silently vanish from the
+        # ir_programs section (write) or read as generic drift (check):
+        # surface the real error instead, like the worklist gate does
+        broken = ", ".join(e["program"] for e in facts["errors"])
+        print(f"xflowlint: programs failed to lower: {broken}",
+              file=sys.stderr)
+        return 3
+    if ir_ok:
+        contracts["ir_programs"] = ir_contract_section(facts)
     path = _contract_artifact_path(args.root)
+    on_disk = None
+    try:
+        with open(path) as f:
+            on_disk = f.read()
+    except OSError:
+        pass
     if write:
+        if not ir_ok and on_disk is not None:
+            # keep the existing jaxpr section rather than silently
+            # shrinking the artifact on a jax-less machine
+            try:
+                prev = json.loads(on_disk).get("ir_programs")
+            except Exception:
+                prev = None
+            if prev is not None:
+                contracts["ir_programs"] = prev
+                print("xflowlint: NOTICE — ir_programs section "
+                      "preserved from the checked-in artifact",
+                      file=sys.stderr)
+        rendered = render_artifact(contracts)
         with open(path, "w") as f:
             f.write(rendered)
         print(f"xflowlint: wrote engine-contract matrix for "
               f"{len(contracts['engines'])} builder(s) to {path}")
         return 0
+    if on_disk is None:
+        print(f"xflowlint: cannot read contract artifact: {path}",
+              file=sys.stderr)
+        return 4
+    disk_doc = None
+    try:
+        disk_doc = json.loads(on_disk)
+    except Exception:
+        pass
+    if not ir_ok and disk_doc is not None and "ir_programs" in disk_doc:
+        # AST-only comparison: strip the section the IR tier would have
+        # produced from both sides
+        disk_doc = dict(disk_doc)
+        disk_doc.pop("ir_programs")
+        on_disk = render_artifact(disk_doc)
+    rendered = render_artifact(contracts)
+    if on_disk == rendered:
+        scope = "" if ir_ok else " (AST sections only)"
+        print(f"xflowlint: engine-contract matrix matches {path} "
+              f"({len(contracts['engines'])} builders){scope}")
+        return 0
+    return _artifact_drift("CONTRACT", path, on_disk, rendered,
+                           "--write-contracts")
+
+
+def _worklist_mode(args, write: bool) -> int:
+    """--write-worklist / --check-worklist: the fusion-worklist gate.
+    tools/fusion_worklist.json is the Pallas kernel arc's target list
+    (XF801's oracle); drift exits 4 like the contract matrix."""
+    from xflow_tpu.analysis.passes.ir_rules import (
+        build_worklist, render_worklist,
+    )
+
+    facts = _ir_facts_or_notice(args.root, args.no_ir)
+    path = _worklist_artifact_path(args.root)
+    if facts is None:
+        if write:
+            print("xflowlint: cannot regenerate the fusion worklist "
+                  "without the IR tier", file=sys.stderr)
+            return 3
+        print("xflowlint: fusion-worklist check SKIPPED (IR tier "
+              "unavailable)", file=sys.stderr)
+        return 0
+    if facts.get("errors"):
+        broken = ", ".join(e["program"] for e in facts["errors"])
+        print(f"xflowlint: programs failed to lower: {broken}",
+              file=sys.stderr)
+        return 3
+    worklist = build_worklist(facts)
+    rendered = render_worklist(worklist)
+    n = len(worklist["entries"])
+    if write:
+        with open(path, "w") as f:
+            f.write(rendered)
+        print(f"xflowlint: wrote fusion worklist ({n} chains) to {path}")
+        return 0
     try:
         with open(path) as f:
             on_disk = f.read()
     except OSError as e:
-        print(f"xflowlint: cannot read contract artifact: {e}",
+        print(f"xflowlint: cannot read worklist artifact: {e}",
               file=sys.stderr)
         return 4
     if on_disk == rendered:
-        print(f"xflowlint: engine-contract matrix matches {path} "
-              f"({len(contracts['engines'])} builders)")
+        print(f"xflowlint: fusion worklist matches {path} ({n} chains)")
         return 0
-    import difflib
-
-    diff = difflib.unified_diff(
-        on_disk.splitlines(), rendered.splitlines(),
-        fromfile="checked-in", tofile="extracted", lineterm="", n=2)
-    lines = list(diff)[:40]
-    print("xflowlint: CONTRACT DRIFT — a builder's extracted sharding "
-          "contract differs from tools/engine_contracts.json:",
-          file=sys.stderr)
-    for ln in lines:
-        print(f"  {ln}", file=sys.stderr)
-    print("xflowlint: if the change is intended, regenerate with "
-          "`python tools/xflowlint.py --write-contracts` and review "
-          "the diff (it is the unified-builder acceptance oracle)",
-          file=sys.stderr)
-    return 4
+    return _artifact_drift("WORKLIST", path, on_disk, rendered,
+                           "--write-worklist")
 
 
 def main(argv=None) -> int:
@@ -157,24 +282,41 @@ def main(argv=None) -> int:
                     help="ignore any baseline (report everything)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="record current findings as the new baseline "
-                         "(audit reasons by hand afterwards)")
+                         "(NEW entries require --reason)")
+    ap.add_argument("--reason", default=None,
+                    help="justification recorded on NEW baseline entries "
+                         "written by --write-baseline (audited entries "
+                         "keep their existing reasons)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (e.g. XF101,XF301)")
     ap.add_argument("--changed", action="store_true",
                     help="lint only git-changed files (worktree, staged, "
                          "untracked), growth-gated against the repo "
                          "baseline — the pre-commit fast path")
-    ap.add_argument("--jobs", "-j", type=int, default=1,
+    ap.add_argument("--jobs", "-j", type=int, default=0,
                     help="fan per-module passes out over N processes "
-                         "(0 = cpu count, capped at 8 — more workers "
-                         "than file chunks just pay fork cost); output "
-                         "is identical to -j 1")
+                         "(default 0 = cpu count, capped at 8 — more "
+                         "workers than file chunks just pay fork cost); "
+                         "output is identical to -j 1")
+    ap.add_argument("--ir", action="store_true",
+                    help="force the IR tier (jaxpr rules XF801-XF804) on "
+                         "this run; default: on for full-tree runs, off "
+                         "for explicit paths / --changed")
+    ap.add_argument("--no-ir", action="store_true",
+                    help="skip the IR tier (AST rules only; artifact "
+                         "checks compare their AST sections only)")
     ap.add_argument("--write-contracts", action="store_true",
                     help="regenerate tools/engine_contracts.json (the "
-                         "engine sharding-contract matrix)")
+                         "engine sharding-contract matrix + jaxpr section)")
     ap.add_argument("--check-contracts", action="store_true",
                     help="fail with exit 4 if the extracted contract "
                          "matrix drifted from tools/engine_contracts.json")
+    ap.add_argument("--write-worklist", action="store_true",
+                    help="regenerate tools/fusion_worklist.json (the "
+                         "kernel arc's fusion target list)")
+    ap.add_argument("--check-worklist", action="store_true",
+                    help="fail with exit 4 if the extracted fusion "
+                         "worklist drifted from tools/fusion_worklist.json")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ap.add_argument("--list-rules", action="store_true")
@@ -187,13 +329,24 @@ def main(argv=None) -> int:
             print(f"{name}: {', '.join(rules)}")
         return 0
 
-    if args.write_contracts or args.check_contracts:
+    if args.ir and args.no_ir:
+        print("xflowlint: --ir and --no-ir are mutually exclusive",
+              file=sys.stderr)
+        return 3
+
+    artifact_modes = (args.write_contracts or args.check_contracts
+                      or args.write_worklist or args.check_worklist)
+    if artifact_modes:
         if args.paths or args.changed:
-            print("xflowlint: --write/check-contracts operates on the "
-                  "whole tree under --root; drop the explicit paths",
+            print("xflowlint: the artifact modes operate on the whole "
+                  "tree under --root; drop the explicit paths",
                   file=sys.stderr)
             return 3
-        return _contracts_mode(args, write=args.write_contracts)
+        if args.write_contracts or args.check_contracts:
+            rc = _contracts_mode(args, write=args.write_contracts)
+            if rc != 0 or not (args.write_worklist or args.check_worklist):
+                return rc
+        return _worklist_mode(args, write=args.write_worklist)
 
     jobs = args.jobs
     if jobs == 0:
@@ -208,6 +361,11 @@ def main(argv=None) -> int:
             print(f"xflowlint: unknown rule(s): {', '.join(sorted(bad))}",
                   file=sys.stderr)
             return 3
+
+    if args.ir and (args.paths or args.changed):
+        print("xflowlint: --ir needs a full-tree run (the IR tier "
+              "imports and lowers the whole engine)", file=sys.stderr)
+        return 3
 
     paths = args.paths or None
     if args.changed:
@@ -226,7 +384,29 @@ def main(argv=None) -> int:
     except OSError as e:
         print(f"xflowlint: {e}", file=sys.stderr)
         return 3
-    findings = run_passes(project, only_rules=only, jobs=jobs)
+
+    # tier selection: full-tree runs get the IR tier by default (it is
+    # the CI law); explicit-path and --changed scans stay AST-only for
+    # speed unless --ir forces a full-tree semantic run
+    use_ir = not args.no_ir and (args.ir or
+                                 (project.full_tree and not args.changed))
+    tiers = ("ast", "ir") if use_ir else ("ast",)
+    findings = run_passes(project, only_rules=only, jobs=jobs, tiers=tiers)
+    ir_ran = False
+    if use_ir:
+        from xflow_tpu.analysis.passes import ir_rules
+
+        state, detail = ir_rules.LAST_STATUS
+        # partial runs (a program failed to lower) don't count as "the
+        # IR tier ran" for baseline purposes: a finding in the broken
+        # program produced no verdict either way
+        ir_ran = state == "ok" and not detail
+        if state == "skipped":
+            print(f"xflowlint: NOTICE — IR tier skipped ({detail}); "
+                  "AST-tier results only", file=sys.stderr)
+        elif detail:
+            print(f"xflowlint: NOTICE — IR tier partial: {detail}",
+                  file=sys.stderr)
 
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline \
@@ -262,21 +442,77 @@ def main(argv=None) -> int:
 
         seen = set()
         # reasons carry over from the TARGET file (the baseline actually
-        # being rewritten), so an audited reason survives regeneration
+        # being rewritten), so an audited reason survives regeneration;
+        # NEW entries take --reason — without it they are refused, so
+        # the placeholder can never land in a checked-in baseline again
         reasons = {(e.rule, e.path, e.message): e.reason
-                   for e in Baseline.load(target).entries}
+                   for e in Baseline.load(target).entries
+                   if e.reason and e.reason != REASON_PLACEHOLDER}
+        unreasoned = []
         for f in findings:
             fp = f.fingerprint()
             if fp in seen:
                 continue
             seen.add(fp)
+            reason = reasons.get(fp) or args.reason
+            if not reason:
+                unreasoned.append(f)
+                continue
             out.entries.append(BaselineEntry(
                 rule=f.rule, path=f.path, message=f.message,
-                reason=reasons.get(fp, "TODO: justify or fix")))
+                reason=reason))
+        if not ir_ran:
+            # IR-tier rules never ran this time (jax absent, --no-ir,
+            # or a partial lowering): their existing entries cannot
+            # have been fixed — carry them over instead of silently
+            # dropping them from the rewritten baseline. Carried
+            # entries still go through the reason policy: a placeholder
+            # reason is replaced by --reason or refused, so the write
+            # can never produce a baseline that fails its own audit
+            for e in Baseline.load(target).entries:
+                if e.rule not in IR_RULES \
+                        or (e.rule, e.path, e.message) in seen:
+                    continue
+                seen.add((e.rule, e.path, e.message))
+                if not e.reason or e.reason == REASON_PLACEHOLDER:
+                    if not args.reason:
+                        unreasoned.append(Finding(
+                            rule=e.rule, path=e.path, line=1,
+                            message=e.message))
+                        continue
+                    e.reason = args.reason
+                out.entries.append(e)
+        if unreasoned:
+            print(
+                "xflowlint: --write-baseline refused — "
+                f"{len(unreasoned)} NEW entr"
+                f"{'y' if len(unreasoned) == 1 else 'ies'} without a "
+                "justification; pass --reason \"why this finding is "
+                "accepted\" (prefer fixing the finding instead):",
+                file=sys.stderr)
+            for f in unreasoned[:10]:
+                print(f"  {f.path}: {f.rule}: {f.message}",
+                      file=sys.stderr)
+            return 3
         out.save(target)
         print(f"xflowlint: wrote {len(out.entries)} baseline entr"
               f"{'y' if len(out.entries) == 1 else 'ies'} to {target}")
         return 0
+
+    # baseline audit: the placeholder reason must never gate CI — it
+    # means an entry was recorded without a human justification
+    placeholders = [e for e in baseline.entries
+                    if e.reason == REASON_PLACEHOLDER]
+    if placeholders:
+        print(
+            f"xflowlint: baseline audit FAILED — {len(placeholders)} "
+            f"entr{'y' if len(placeholders) == 1 else 'ies'} still "
+            f"carry the {REASON_PLACEHOLDER!r} placeholder reason; "
+            "justify (edit the reason) or fix the finding and remove "
+            "the entry:", file=sys.stderr)
+        for e in placeholders[:10]:
+            print(f"  {e.path}: {e.rule}: {e.message}", file=sys.stderr)
+        return 3
 
     scanned = None
     if args.changed:
@@ -290,6 +526,10 @@ def main(argv=None) -> int:
         from xflow_tpu.analysis.core import FULL_TREE_RULES
 
         stale = [e for e in stale if e.rule not in FULL_TREE_RULES]
+    if not ir_ran:
+        # IR-tier rules never ran (tier off, jax absent, or tree not
+        # importable): their entries cannot have been fixed either
+        stale = [e for e in stale if e.rule not in IR_RULES]
 
     if args.json:
         import dataclasses

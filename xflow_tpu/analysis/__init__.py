@@ -11,19 +11,28 @@ JsonlAppender interleave), every `cfg.section.key` read resolves to a
 config.py default, and every record flowing into the stamped JSONL
 appender matches the schema tables in docs/OBSERVABILITY.md.
 
-`xflow_tpu/analysis/` enforces those mechanically, from the AST alone
-(stdlib `ast`; no new dependencies, nothing is imported or
-executed), so `tools/smoke_lint.sh` can gate them in CI before the
-unified-engine churn the ROADMAP plans. See docs/STATIC_ANALYSIS.md
-for the rule catalog and the suppression/baseline workflow.
+`xflow_tpu/analysis/` enforces those mechanically in two tiers: the
+AST tier works from stdlib `ast` alone (no new dependencies, nothing
+imported or executed — lints without jax, on scratch copies), and the
+IR tier (ir.py) deliberately lowers the engine builders' jitted
+programs to jaxprs in a pinned CPU subprocess — trace-only, no
+execution — for the semantic rules (XF8xx) and the fusion-worklist /
+contracts-v2 artifacts the AST cannot state. `tools/smoke_lint.sh`
+gates both in CI before the unified-engine churn the ROADMAP plans.
+See docs/STATIC_ANALYSIS.md for the rule catalog, the tier contract,
+and the suppression/baseline workflow.
 
 Layout:
 - core.py      — Finding model, suppression parsing, baseline files,
                  the Project/Module source graph every pass shares
+- dataflow.py  — the flow-sensitive abstract interpreter
+- ir.py        — the IR-tier extractor (subprocess; jaxpr facts)
 - passes/      — one module per rule family (jit purity, recompile
                  hazards, thread-safety lockset, config cross-check,
-                 JSONL schema drift, shell strict-mode)
-- tools/xflowlint.py — the CLI (repo-wide lint, --baseline gating)
+                 JSONL schema drift, shell strict-mode, sharding
+                 contracts, host-sync taint, IR rules)
+- tools/xflowlint.py — the CLI (repo-wide lint, --baseline gating,
+                 artifact modes)
 """
 
 from xflow_tpu.analysis.core import (  # noqa: F401

@@ -311,16 +311,23 @@ class Baseline:
 # entries stale either — the --changed pre-commit path)
 FULL_TREE_RULES = ("XF402",)
 
+# rules produced by the IR tier (analysis/ir.py): like FULL_TREE_RULES,
+# a run that did not include the tier must not call their baseline
+# entries stale
+IR_RULES = ("XF801", "XF802", "XF803", "XF804")
+
 # populated by xflow_tpu.analysis.passes at import; maps pass name ->
 # (runner, rule ids, scope) so the CLI can list and select. scope
 # "module" = findings derive from one file at a time (parallelizable
 # across a worker pool); "project" = needs the whole source set at
-# once (cross-module comparisons, dead-key analysis).
+# once (cross-module comparisons, dead-key analysis); "ir" = the
+# jaxpr tier (analysis/ir.py) — runs in-process only when the caller
+# opts into the "ir" tier, never in the worker pool.
 PASS_REGISTRY: dict[str, tuple] = {}
 
 
 def register_pass(name: str, rules: tuple, scope: str = "module") -> Callable:
-    assert scope in ("module", "project"), scope
+    assert scope in ("module", "project", "ir"), scope
 
     def deco(fn: Callable) -> Callable:
         PASS_REGISTRY[name] = (fn, rules, scope)
@@ -370,17 +377,18 @@ def _mp_worker(payload) -> list:
 
 
 def _run_parallel(project: Project, only_rules: Optional[set],
-                  jobs: int) -> list:
+                  jobs: int, extra_passes: list) -> list:
     """Module-scope passes fan out over a fork pool (one chunk of files
-    per worker); project-scope passes run in-process on the full tree.
-    Output is merged raw findings — identical to the serial path after
-    the shared suppress/dedup/sort."""
+    per worker); project-scope passes (plus any opted-in IR-tier
+    passes) run in-process on the full tree. Output is merged raw
+    findings — identical to the serial path after the shared
+    suppress/dedup/sort."""
     import multiprocessing
 
     module_passes = [n for n, (_f, _r, s) in PASS_REGISTRY.items()
                      if s == "module"]
     project_passes = [n for n, (_f, _r, s) in PASS_REGISTRY.items()
-                      if s == "project"]
+                      if s == "project"] + extra_passes
     paths = [m.path for m in project.modules] \
         + [s.path for s in project.shell_scripts]
     chunks = [c for c in (paths[i::jobs] for i in range(jobs)) if c]
@@ -400,24 +408,32 @@ def _run_parallel(project: Project, only_rules: Optional[set],
 
 
 def run_passes(project: Project, only_rules: Optional[set] = None,
-               jobs: int = 1) -> list:
-    """Run every registered pass, apply suppressions, return findings
-    sorted by (path, line, rule). Unparseable files yield XF001.
-    `jobs` > 1 fans the per-module passes out over a process pool
-    (same findings, same order — the pre-commit speed path); any pool
-    failure falls back to the serial sweep."""
+               jobs: int = 1, tiers: tuple = ("ast",)) -> list:
+    """Run every registered pass of the selected `tiers`, apply
+    suppressions, return findings sorted by (path, line, rule).
+    Unparseable files yield XF001. `jobs` > 1 fans the per-module
+    passes out over a process pool (same findings, same order — the
+    pre-commit speed path); any pool failure falls back to the serial
+    sweep. `tiers` defaults to the AST tier only; adding "ir" also
+    runs the jaxpr-tier passes (scope="ir", always in-process)."""
     import xflow_tpu.analysis.passes  # noqa: F401  (registers passes)
 
+    selected = {n for n, (_f, _r, s) in PASS_REGISTRY.items()
+                if s in ("module", "project") and "ast" in tiers
+                or s == "ir" and "ir" in tiers}
+    ir_passes = [n for n in selected
+                 if PASS_REGISTRY[n][2] == "ir"]
     raw: list[Finding]
-    if jobs > 1 and len(project.modules) + len(project.shell_scripts) > 1:
+    if jobs > 1 and len(project.modules) + len(project.shell_scripts) > 1 \
+            and "ast" in tiers:
         try:
-            raw = _run_parallel(project, only_rules, jobs)
+            raw = _run_parallel(project, only_rules, jobs, ir_passes)
         except Exception:  # pragma: no cover — pool/platform failure
-            raw = _run_selected(project, set(PASS_REGISTRY), only_rules,
+            raw = _run_selected(project, selected, only_rules,
                                 with_syntax=True)
     else:
-        raw = _run_selected(project, set(PASS_REGISTRY), only_rules,
-                            with_syntax=True)
+        raw = _run_selected(project, selected, only_rules,
+                            with_syntax="ast" in tiers)
     sources = {m.relpath: m for m in project.modules}
     sources.update({s.relpath: s for s in project.shell_scripts})
     findings = []

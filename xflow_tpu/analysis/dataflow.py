@@ -409,11 +409,21 @@ class Dataflow:
             return BOTTOM
         if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
                              ast.DictComp)):
+            # comprehension scope: bindings live in a COPY of the env
+            # (python gives comprehensions their own scope — the target
+            # must neither leak out nor clobber an outer binding), and
+            # the target varies per iteration exactly like a for-loop
+            # target, tagged with the comprehension node as its binding
+            # loop (the XF202 enclosure check accepts comprehensions)
             cenv = dict(env)
             for gen in node.generators:
                 itv = self.eval(gen.iter, cenv)
                 self.hooks.at_iter(gen.iter, itv, cenv, self)
-                self.assign(gen.target, propagated(itv), cenv)
+                loopval = AbsVal(
+                    tags=itv.tags | {"loopvar"}, fresh=itv.fresh,
+                    loops=itv.loops | {id(node)}, origin=node.lineno,
+                )
+                self.assign(gen.target, loopval, cenv)
                 for cond in gen.ifs:
                     self.eval(cond, cenv)
             if isinstance(node, ast.DictComp):

@@ -5,6 +5,7 @@ never breaks `import xflow_tpu.analysis`)."""
 from xflow_tpu.analysis.passes import (  # noqa: F401
     config_keys,
     hostsync,
+    ir_rules,
     jit_purity,
     lockset,
     recompile,

@@ -289,8 +289,9 @@ def _inside_binding_loop(call: ast.AST, loop_ids: frozenset,
                          parents: dict) -> bool:
     cur = parents.get(call)
     while cur is not None:
-        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)) \
-                and id(cur) in loop_ids:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While,
+                            ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                            ast.DictComp)) and id(cur) in loop_ids:
             return True
         if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
                             ast.Lambda)):
