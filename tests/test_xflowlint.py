@@ -894,7 +894,7 @@ def test_config_tree_parser_matches_dataclasses():
     tree = ConfigTree.parse(os.path.join(REPO_ROOT, "xflow_tpu",
                                          "config.py"))
     assert set(tree.sections) == {"model", "optim", "data", "mesh",
-                                  "train", "serve"}
+                                  "train", "serve", "sync"}
     assert tree.resolve(("train", "log_every"))[0] == "ok"
     assert tree.resolve(("optim", "ftrl", "alpha"))[0] == "ok"
     assert tree.resolve(("num_slots",))[0] == "ok"  # Config property

@@ -150,6 +150,31 @@ PIPELINE_SUM_SLACK = 1.25
 # root has none), everything else is the assembly contract
 # tools/request_trace.py depends on
 SPAN_KEYS = ("trace", "span", "name", "t0", "dur_ms")
+# the key set every kind="sync" record carries (parallel/multislice
+# .SliceSyncer.sync — docs/OBSERVABILITY.md "Multi-slice sync
+# records"); --check enforces all-or-none, a strictly increasing round
+# per stream (each sync bumps by one; a rejoin generation is its own
+# stream), the membership ledger (this round's live set must equal the
+# previous round's minus `left` plus `joined` — a silent membership
+# jump means a sync record was lost or forged), and the staleness
+# arithmetic (stale = live peers lagging > k; lag_max = max lag)
+SYNC_KEYS = (
+    "round",
+    "k",
+    "mode",
+    "live",
+    "joined",
+    "left",
+    "bytes_out",
+    "bytes_in",
+    "applied",
+    "stale",
+    "timeouts",
+    "lag_max",
+    "lags",
+    "dur_ms",
+)
+SYNC_MODES = ("sync", "bounded", "async")
 # request-path span names come from xflow_tpu.tracing (the source of
 # truth): the cross-stream parenting gates below apply to those;
 # operational spans — reload/checkpoint_save/… — are one-span traces
@@ -524,17 +549,28 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         for rec in records:
             w = rec.get("world")
             if isinstance(w, int) and w > 0:
-                worlds.setdefault((run_id, gen), set()).add(w)
-                if not rank_flagged and isinstance(rank, int) and rank >= w:
+                # multi-slice runs stamp `slice`: the rank is the
+                # slice's id in the SYNC GROUP while `world` is the
+                # slice's own (ICI) world size — two different
+                # topologies, so the rank<world gate keys per slice
+                sl = rec.get("slice")
+                worlds.setdefault((run_id, gen, sl), set()).add(w)
+                if (
+                    not rank_flagged
+                    and sl is None
+                    and isinstance(rank, int)
+                    and rank >= w
+                ):
                     rank_flagged = True
                     problems.append(
                         f"run {run_id} rank {rank} [{kind}] gen {gen}: "
                         f"rank id >= its generation's world size {w}"
                     )
-    for (run_id, gen), seen in sorted(worlds.items(), key=str):
+    for (run_id, gen, sl), seen in sorted(worlds.items(), key=str):
         if len(seen) > 1:
+            where = f"gen {gen}" + (f" slice {sl}" if sl is not None else "")
             problems.append(
-                f"run {run_id} gen {gen}: world stamp disagrees across "
+                f"run {run_id} {where}: world stamp disagrees across "
                 f"streams ({sorted(seen)}) — ranks of one generation "
                 "launched with different world sizes"
             )
@@ -552,6 +588,9 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         # moves forward; a regression means a swap raced or went back)
         seen_programs: dict = {}  # compile streams: (program, sig) ->
         # record index — the exactly-once recompile gate
+        last_round = 0  # sync streams: rounds count 1, 2, 3, ... within
+        # a generation — a repeat or skip means a lost or forged record
+        prev_live = None  # sync streams: membership ledger
         for i, rec in enumerate(records, 1):
             for key in STAMP_KEYS:
                 if key not in rec:
@@ -698,6 +737,86 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                             f"({last_model_gen} -> {mg}) at record {i}"
                         )
                     last_model_gen = max(last_model_gen, mg)
+            if kind == "sync":
+                sy_missing = [k for k in SYNC_KEYS if k not in rec]
+                if sy_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks sync keys {sy_missing}"
+                    )
+                    continue
+                if rec["mode"] not in SYNC_MODES:
+                    problems.append(
+                        f"{tag}: record {i} has unknown sync mode "
+                        f"{rec['mode']!r}"
+                    )
+                if rec["mode"] == "sync" and rec["k"] != 0:
+                    problems.append(
+                        f"{tag}: record {i} stamps mode=sync with "
+                        f"k={rec['k']} — lockstep mode is k=0 by definition"
+                    )
+                rnd = rec["round"]
+                # a stream's FIRST round may start anywhere >= 1: a
+                # rejoined generation continues the slice's numbering
+                # past its snapshot catch-up point. After that, +1 each
+                # record — a repeat or skip means a lost or forged one.
+                bad_first = last_round == 0 and (not _finite(rnd) or rnd < 1)
+                bad_next = last_round > 0 and (
+                    not _finite(rnd) or rnd != last_round + 1
+                )
+                if bad_first or bad_next:
+                    problems.append(
+                        f"{tag}: round {last_round} -> {rnd} at record "
+                        f"{i} — rounds increment by one within a "
+                        "generation (a repeat or skip means a lost or "
+                        "forged sync record)"
+                    )
+                if _finite(rnd):
+                    last_round = max(last_round, int(rnd))
+                live, joined, left = rec["live"], rec["joined"], rec["left"]
+                if not all(isinstance(v, list) for v in (live, joined, left)):
+                    problems.append(
+                        f"{tag}: record {i} live/joined/left are not lists"
+                    )
+                else:
+                    if prev_live is not None and set(live) != (
+                        (prev_live - set(left)) | set(joined)
+                    ):
+                        problems.append(
+                            f"{tag}: record {i} membership ledger broken: "
+                            f"live {sorted(prev_live)} - left {left} + "
+                            f"joined {joined} != live {live}"
+                        )
+                    prev_live = set(live)
+                lags = rec["lags"]
+                if not isinstance(lags, dict) or not all(
+                    _finite(v) and v >= 0 for v in lags.values()
+                ):
+                    problems.append(
+                        f"{tag}: record {i} lags is not a dict of "
+                        "non-negative rounds-behind counts"
+                    )
+                else:
+                    want_max = max(lags.values(), default=0)
+                    want_stale = sum(
+                        1 for v in lags.values() if _finite(rec["k"]) and v > rec["k"]
+                    )
+                    if rec["lag_max"] != want_max:
+                        problems.append(
+                            f"{tag}: record {i} lag_max {rec['lag_max']} != "
+                            f"max(lags) {want_max}"
+                        )
+                    if rec["stale"] != want_stale:
+                        problems.append(
+                            f"{tag}: record {i} stale {rec['stale']} != "
+                            f"count of lags > k ({want_stale})"
+                        )
+                for key in ("bytes_out", "bytes_in", "applied", "timeouts",
+                            "dur_ms"):
+                    if not _finite(rec[key]) or rec[key] < 0:
+                        problems.append(
+                            f"{tag}: record {i} has non-numeric or "
+                            f"negative {key}"
+                        )
         if kind == "metrics" and step_recs >= 2 and window_recs == 0:
             problems.append(
                 f"{tag}: {step_recs} step records but no window record — "
@@ -1050,7 +1169,64 @@ def render_health(streams: dict) -> str:
     serve_lines = render_serve_latency_split(streams, newest)
     if serve_lines:
         lines.extend(serve_lines)
+    sync_lines = render_sync_staleness(streams, newest)
+    if sync_lines:
+        lines.extend(sync_lines)
     return "\n".join(lines)
+
+
+def render_sync_staleness(streams: dict, run_id: str) -> list[str]:
+    """The multi-slice staleness-lag table for the --health view
+    (docs/DISTRIBUTED.md "Multi-slice bounded staleness"): one line per
+    slice's sync stream (newest generation wins — a rejoined slice
+    reports its post-catch-up stream), then the most-stale peer across
+    every slice's FINAL round, named. The first question a bounded-
+    staleness run answers: who is holding the fleet back, and did
+    anyone breach k? Empty when the run carries no sync records
+    (sync.mode=off)."""
+    by_rank: dict = {}  # rank -> (gen, records), newest gen wins
+    for (rid, rank, kind, gen), recs in sorted(streams.items(), key=str):
+        if kind != "sync" or rid != run_id or not recs:
+            continue
+        if rank not in by_rank or gen > by_rank[rank][0]:
+            by_rank[rank] = (gen, recs)
+    if not by_rank:
+        return []
+    last0 = next(iter(sorted(by_rank.items())))[1][1][-1]
+    out = [
+        f"  sync tier (kind=sync, mode={last0.get('mode')} "
+        f"k={last0.get('k')}):"
+    ]
+    worst = None  # (lag, peer_slice, reporter_rank, reporter_round)
+    for rank, (gen, recs) in sorted(by_rank.items(), key=str):
+        last = recs[-1]
+        stale_total = sum(r.get("stale", 0) for r in recs)
+        timeout_total = sum(r.get("timeouts", 0) for r in recs)
+        left_events = sum(len(r.get("left", ())) for r in recs)
+        join_events = sum(len(r.get("joined", ())) for r in recs)
+        out.append(
+            f"    rank {rank}: rounds {last.get('round')}  "
+            f"stale {stale_total}  timeouts {timeout_total}  "
+            f"membership -{left_events}/+{join_events}  "
+            f"last live {last.get('live')}"
+        )
+        lags = last.get("lags")
+        if isinstance(lags, dict):
+            for peer, lag in lags.items():
+                if _finite(lag) and (worst is None or lag > worst[0]):
+                    worst = (lag, peer, rank, last.get("round"))
+    if worst and worst[0] > 0:
+        out.append(
+            f"    most-stale peer: slice {worst[1]} "
+            f"({worst[0]} round(s) behind rank {worst[2]} at its final "
+            f"round {worst[3]})"
+        )
+    elif worst is not None:
+        out.append(
+            "    most-stale peer: none (every peer caught up at the "
+            "final round)"
+        )
+    return out
 
 
 def render_pipeline_verdict(streams: dict, run_id: str) -> list[str]:

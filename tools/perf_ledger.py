@@ -160,9 +160,7 @@ def normalize_bench(path: str, data) -> list[dict]:
 
 
 def normalize_multichip(path: str, data) -> list[dict]:
-    if not isinstance(data, dict):
-        return []
-    return [{
+    out = [{
         "series": "multichip",
         "round": _round_of(path),
         "path": os.path.basename(path),
@@ -171,7 +169,29 @@ def normalize_multichip(path: str, data) -> list[dict]:
         "unit": "bool",
         "n_devices": data.get("n_devices"),
         "skipped": bool(data.get("skipped")),
-    }]
+    }] if isinstance(data, dict) else []
+    # multi-slice records (MULTICHIP_r16+, tools/smoke_multislice.sh)
+    # also carry the measured aggregate: the N-slice throughput and its
+    # speedup over one slice. The `ok` flag above already folds the
+    # >= 1.8x acceptance gate (the script computes it); these entries
+    # ride the generic higher-is-better tolerance gate across rounds.
+    if isinstance(data, dict) and not data.get("skipped"):
+        for key, unit in (
+            ("speedup", "x"),
+            ("agg_examples_per_sec", "examples/sec"),
+        ):
+            if _finite(data.get(key)):
+                out.append({
+                    "series": "multichip",
+                    "round": _round_of(path),
+                    "path": os.path.basename(path),
+                    "metric": f"multislice_{key}",
+                    "value": float(data[key]),
+                    "unit": unit,
+                    "slices": data.get("slices"),
+                    "skipped": False,
+                })
+    return out
 
 
 def normalize_scale(path: str, data) -> list[dict]:
@@ -348,7 +368,7 @@ def check_regressions(
             continue
         newest = rounds[-1]
         prev = rounds[:-1]
-        if series == "multichip":
+        if series == "multichip" and metric == "multichip_ok":
             if newest.get("skipped"):
                 continue
             if newest["value"] < 1.0 and any(e["value"] >= 1.0 for e in prev):
@@ -492,7 +512,8 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
                 f"| {_fmt(newest['value'])} | {_fmt(newest.get('vs_baseline'))} |"
             )
         lines.append("")
-    multi = [e for e in entries if e["series"] == "multichip"]
+    multi = [e for e in entries if e["series"] == "multichip"
+             and e["metric"] == "multichip_ok"]
     if multi:
         lines += ["## Multichip dryrun (`MULTICHIP_r*.json`)", "",
                   "| round | devices | verdict |", "|---|---|---|"]
@@ -502,6 +523,27 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
             lines.append(f"| r{_fmt(e['round'])} | {_fmt(e.get('n_devices'))} "
                          f"| {verdict} |")
         lines.append("")
+        # multi-slice rounds publish measured numbers too — print the
+        # speedup trail under the verdict table
+        speed = [e for e in entries if e["series"] == "multichip"
+                 and e["metric"] == "multislice_speedup"
+                 and _finite(e["value"])]
+        for e in sorted(speed, key=lambda e: e["round"] or -1):
+            agg = next(
+                (a["value"] for a in entries
+                 if a["series"] == "multichip"
+                 and a["metric"] == "multislice_agg_examples_per_sec"
+                 and a["round"] == e["round"] and _finite(a["value"])),
+                None,
+            )
+            agg_txt = f", aggregate {agg:.0f} examples/sec" if agg else ""
+            lines.append(
+                f"multi-slice r{_fmt(e['round'])}: "
+                f"{_fmt(e.get('slices'))} slice(s) at {e['value']:.2f}x "
+                f"one slice{agg_txt}"
+            )
+        if speed:
+            lines.append("")
     lab = groups_of([e for e in entries if e["series"] == "lab"])
     if lab:
         lines += ["## Sparse-primitive lab (`BENCH_LAB*.json`)", "",

@@ -488,6 +488,47 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class SyncConfig:
+    """Cross-slice bounded-staleness table sync — the DCN tier of the
+    two-tier topology (parallel/multislice.py, docs/DISTRIBUTED.md
+    "Multi-slice bounded staleness"). Each slice trains synchronously
+    inside its own mesh; between K-step blocks a host-level SliceSyncer
+    exchanges additive table deltas with the other slices through a
+    shared directory, with parameter-server failure semantics
+    (timeout + retry/backoff on every wait, proceed-on-stale policy,
+    dead slices dropped from the sync group)."""
+
+    # off = no sync tier at all (byte-identical trainer behavior);
+    # sync = wait for every live peer's current round (K is forced 0 —
+    # lockstep across slices, today's fully-sync semantics);
+    # bounded = wait only until every live peer is within staleness_k
+    # rounds; async = never wait, apply whatever deltas have landed
+    mode: str = "off"
+    # the staleness bound K, in sync ROUNDS a live peer may trail
+    # before the on_stale policy triggers (bounded mode only)
+    staleness_k: int = 0
+    # steps between sync rounds (the K-step scan block boundary)
+    every_steps: int = 50
+    # the shared sync directory (deltas + snapshots + membership);
+    # launch-multislice wires it to <run_dir>/sync for every slice
+    dir: str = ""
+    # per-wait budget before a retry; every exchange is bounded — a
+    # vanished peer costs timeout_s * (retries + 1), never a hang
+    timeout_s: float = 30.0
+    # staleness-wait retries, backoff_s * 2^attempt (jittered, the
+    # supervise.backoff_delay curve) between them
+    retries: int = 3
+    backoff_s: float = 0.5
+    # what a missed staleness bound does after the retry budget:
+    # wait = keep training only after the bounded wait (counted);
+    # proceed = check once and continue on stale state (counted)
+    on_stale: str = "wait"
+    # publish a full-state catch-up snapshot every this many rounds
+    # (0 = never); a rejoining slice adopts the freshest one
+    snapshot_every: int = 10
+
+
+@dataclass(frozen=True)
 class Config:
     model: ModelConfig = field(default_factory=ModelConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
@@ -495,6 +536,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    sync: SyncConfig = field(default_factory=SyncConfig)
 
     @property
     def num_slots(self) -> int:

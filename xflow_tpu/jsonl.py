@@ -58,6 +58,8 @@ class JsonlAppender:
         # an explicit stamp may already carry `replica`; None still
         # resolves lazily (fleet replicas export XFLOW_REPLICA)
         self._replica_resolved = bool(stamp) and "replica" in stamp
+        # likewise `slice` (multi-slice runs export XFLOW_SLICE)
+        self._slice_resolved = bool(stamp) and "slice" in stamp
 
     def _stamp(self) -> dict:
         if self._static is None:
@@ -97,6 +99,18 @@ class JsonlAppender:
                 if port is not None:
                     extra["port"] = port
                 self._static = {**self._static, **extra}
+        if not self._slice_resolved:
+            # multi-slice identity (docs/DISTRIBUTED.md "Multi-slice
+            # bounded staleness"): the slice index, resolved lazily like
+            # replica. Only launch-multislice children export
+            # XFLOW_SLICE, so everyone else's records stay
+            # byte-identical — absent keys, not nulls.
+            from xflow_tpu.telemetry import resolve_slice
+
+            self._slice_resolved = True
+            sl = resolve_slice()
+            if sl is not None:
+                self._static = {**self._static, "slice": sl}
         return self._static
 
     @property

@@ -318,6 +318,18 @@ def cmd_launch_local(args) -> int:
     )
 
 
+def cmd_launch_multislice(args) -> int:
+    from xflow_tpu.parallel.multislice import launch_multislice
+
+    return launch_multislice(
+        args.slices, args.forward, run_dir=args.run_dir,
+        straggler_factor=args.straggler_factor, dead_after_s=args.dead_after_s,
+        watchdog_poll_s=args.watchdog_poll_s,
+        max_restarts=args.max_restarts, restart_backoff=args.restart_backoff,
+        min_uptime_s=args.min_uptime_s,
+    )
+
+
 def cmd_launch_dist(args) -> int:
     from xflow_tpu.launch.dist import launch_dist, parse_hosts
 
@@ -533,6 +545,33 @@ def main(argv=None) -> int:
     ll.add_argument("forward", nargs=argparse.REMAINDER,
                     help="-- followed by `xflow train` args to run in every process")
     ll.set_defaults(fn=cmd_launch_local)
+
+    lm = sub.add_parser(
+        "launch-multislice",
+        help="emulate N slices with bounded-staleness table sync "
+             "across them (sync.mode/staleness_k; each slice is an "
+             "independent supervised `xflow train`; "
+             "docs/DISTRIBUTED.md 'Multi-slice bounded staleness')",
+    )
+    lm.add_argument("--slices", type=int, default=2,
+                    help="slice count (default 2); each slice is its own "
+                         "single-process training world exchanging table "
+                         "deltas via <run-dir>/sync")
+    lm.add_argument("--run-dir", required=True,
+                    help="REQUIRED shared run dir: the sync tier lives in "
+                         "<run-dir>/sync (deltas, snapshots, "
+                         "membership.json) and slice j writes "
+                         "<run-dir>/metrics_rank<j>.jsonl + "
+                         "heartbeat_rank<j>.jsonl; summarize with "
+                         "tools/metrics_report.py")
+    _add_watchdog_flags(lm)
+    _add_supervise_flags(lm)
+    lm.add_argument("forward", nargs=argparse.REMAINDER,
+                    help="-- followed by `xflow train` args for every "
+                         "slice; the literal {slice} substitutes to the "
+                         "slice index (per-slice --train prefix / "
+                         "--checkpoint-dir)")
+    lm.set_defaults(fn=cmd_launch_multislice)
 
     ld = sub.add_parser(
         "launch-dist",

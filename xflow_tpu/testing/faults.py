@@ -371,6 +371,69 @@ def serve_faults_from_env() -> tuple[float, int]:
     return max(delay, 0.0), max(kill, 0)
 
 
+# -------------------------------------------------------------- sync faults
+def sync_faults_from_env() -> tuple[int, float]:
+    """(kill_round, sync_delay_s) for THIS slice's sync tier — the
+    multi-slice chaos injectors (parallel/multislice.SliceSyncer
+    resolves them ONCE at construction, zero per-round cost unset).
+
+    Env contract (tools/smoke_multislice.sh exports these):
+    - XFLOW_FAULT_SLICE_KILL_ROUND: SIGKILL this slice the moment it
+      ENTERS that 1-based sync round, before publishing its delta — the
+      slice-loss drill: survivors must drop it from the sync group and
+      continue degraded, and its supervised relaunch must catch up from
+      the freshest published snapshot.
+    - XFLOW_FAULT_SYNC_DELAY_S: sleep this long inside EVERY sync round
+      — a persistently straggling slice (the staleness-bound /
+      proceed-on-stale drill; peers see its lag grow past K).
+    - XFLOW_FAULT_SLICE: restrict either fault to one slice index
+      (default: all; matched against XFLOW_SLICE via
+      telemetry.resolve_slice). XFLOW_FAULT_SLICE_KILL_SLICE /
+      XFLOW_FAULT_SYNC_DELAY_SLICE override it per injector — the
+      smoke drill kills slice 1 while pacing slice 0 as a straggler so
+      the survivor's sync trail deterministically records the
+      leave/degraded/rejoin sequence.
+    - XFLOW_FAULT_SLICE_KILL_GEN (default 0): only kill in this restart
+      generation — the relaunched slice (which inherits the env) must
+      survive and REJOIN, not re-die at round R forever (same contract
+      as XFLOW_FAULT_KILL_GEN).
+    """
+    from xflow_tpu.telemetry import resolve_restart_gen, resolve_slice
+
+    def _num(name: str, cast, default):
+        try:
+            return cast(os.environ.get(name, default) or default)
+        except ValueError:
+            return cast(default)
+
+    def _targeted(var: str) -> bool:
+        """True when the injector guarded by `var` aims at THIS slice
+        (unset target = every slice; unparseable = no slice)."""
+        target = os.environ.get(var, os.environ.get("XFLOW_FAULT_SLICE"))
+        if target is None:
+            return True
+        try:
+            return int(target) == resolve_slice()
+        except (ValueError, TypeError):
+            return False
+
+    kill = (
+        _num("XFLOW_FAULT_SLICE_KILL_ROUND", int, 0)
+        if _targeted("XFLOW_FAULT_SLICE_KILL_SLICE") else 0
+    )
+    # the straggler can aim at a DIFFERENT slice than the kill (the
+    # smoke drill paces the survivor while killing its peer)
+    delay = (
+        _num("XFLOW_FAULT_SYNC_DELAY_S", float, 0.0)
+        if _targeted("XFLOW_FAULT_SYNC_DELAY_SLICE") else 0.0
+    )
+    if kill > 0 and resolve_restart_gen() != _num(
+        "XFLOW_FAULT_SLICE_KILL_GEN", int, 0
+    ):
+        kill = 0
+    return max(kill, 0), max(delay, 0.0)
+
+
 # ------------------------------------------------------------ pacing faults
 def fit_delays_from_env(rank: int) -> tuple[float, int, float]:
     """(per_step_sleep_s, stall_step, stall_s) for this rank — the

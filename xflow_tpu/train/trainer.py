@@ -40,6 +40,7 @@ from xflow_tpu.telemetry import (
     default_registry,
     hbm_window_fields,
     install_stack_dump_handler,
+    resolve_restart_gen,
     resolve_run_id,
 )
 from xflow_tpu.optim import get_optimizer
@@ -112,9 +113,19 @@ class Trainer:
         # seam every step/predict jit routes through, and its
         # kind="compile" records land in the same stamped stream.
         self.run_id = resolve_run_id()
+        # multi-slice identity: slice j stamps rank j (XFLOW_PROCESS_ID,
+        # exported by launch-multislice) even though each slice is
+        # process 0 of its own single-process world — the shared
+        # watchdog and metrics_report key per-slice streams on the rank
+        # stamp. Everyone else keeps the process index, byte-identical.
+        self._stamp_rank = self.rank
+        if os.environ.get("XFLOW_SLICE") is not None:
+            from xflow_tpu.telemetry import resolve_rank
+
+            self._stamp_rank = resolve_rank()
         self.metrics = MetricsLogger(
             cfg.train.metrics_path,
-            stamp={"rank": self.rank, "run_id": self.run_id},
+            stamp={"rank": self._stamp_rank, "run_id": self.run_id},
             max_bytes=cfg.train.metrics_max_bytes,
         )
         # compile accounting (train.compile_metrics, docs/OBSERVABILITY.md
@@ -372,8 +383,27 @@ class Trainer:
         # distinct from metrics when both land in one run dir
         self.heartbeat = JsonlAppender(
             cfg.train.heartbeat_path,
-            stamp={"rank": self.rank, "run_id": self.run_id, "kind": "heartbeat"},
+            stamp={
+                "rank": self._stamp_rank,
+                "run_id": self.run_id,
+                "kind": "heartbeat",
+            },
         )
+        # cross-slice bounded-staleness sync tier (sync.mode, parallel/
+        # multislice.py, docs/DISTRIBUTED.md "Multi-slice bounded
+        # staleness"): the fit loop publishes/gathers additive table
+        # deltas every sync.every_steps steps, OUTSIDE the jit programs.
+        # None when off — the default path stays byte-identical.
+        self._syncer = None
+        if cfg.sync.mode != "off":
+            from xflow_tpu.parallel.multislice import SliceSyncer
+            from xflow_tpu.telemetry import resolve_num_slices, resolve_slice
+
+            self._syncer = SliceSyncer(
+                cfg.sync,
+                slice_id=resolve_slice() or 0,
+                num_slices=resolve_num_slices(),
+            )
         # data-stream position for exact resume (elastic recovery,
         # docs/ROBUSTNESS.md): (epoch, batches consumed within it) plus
         # the TOPOLOGY-INDEPENDENT truth — per-SHARD consumed-batch
@@ -1059,6 +1089,37 @@ class Trainer:
             )
             return guard_halt or (0 < max_consec <= bad_run)
 
+        def run_sync_round() -> None:
+            """One cross-slice sync boundary (parallel/multislice.py):
+            same bracketing discipline as the checkpoint cadence — flush
+            the staged record first (the exchange is a durability
+            window: a peer may SIGKILL us believing our delta landed),
+            beat around the possibly bounded-wait-long exchange so a
+            watchful launcher never reads it as death, tick the hang
+            watchdog after. The kind="sync" record + span land in the
+            same stamped stream as everything else."""
+            emit_pending_record()
+            self.heartbeat.append({"step": res.steps, "event": "sync"})
+            t0_wall, t0 = time.time(), time.perf_counter()
+            self.state, sync_rec = self._syncer.sync(self.state)
+            if self.metrics.enabled:
+                # the GLOBAL step (restored base + this generation's
+                # progress) — checkpoint spans stamp the same counter,
+                # so a rejoined slice's stream stays step-monotone
+                gstep = int(self.state.step)
+                self.metrics.log({"step": gstep, **sync_rec})
+                from xflow_tpu.tracing import emit_op_span
+
+                emit_op_span(
+                    self.metrics, "slice_sync", t0_wall,
+                    time.perf_counter() - t0,
+                    step=gstep,
+                    round=sync_rec["round"],
+                    bytes=sync_rec["bytes_out"] + sync_rec["bytes_in"],
+                )
+            self.heartbeat.append({"step": res.steps})
+            hang.tick()  # a bounded staleness wait is progress, not a hang
+
         def pending_signal() -> int:
             return int(sig_flag["sig"]) if sig_flag and "sig" in sig_flag else 0
 
@@ -1127,6 +1188,30 @@ class Trainer:
                     file=sys.stderr,
                 )
         self._epoch_pos = (start_epoch, max(resume_skips.values(), default=0))
+        # cross-slice sync tier attach (sync.mode != off): a RELAUNCHED
+        # slice (gen > 0) first catches up from the freshest published
+        # table snapshot — its own checkpoint restore above already
+        # pinned step/data position (the zero-lost-examples half of the
+        # rejoin), the snapshot brings the peers' table contributions
+        # its dead generation missed. attach() then fixes the delta
+        # base, so the first sync publishes exactly this fit's progress.
+        if self._syncer is not None:
+            if resolve_restart_gen() > 0:
+                t0_wall, t0 = time.time(), time.perf_counter()
+                self.state, adopted = self._syncer.adopt_latest_snapshot(
+                    self.state
+                )
+                if adopted is not None:
+                    print(
+                        f"multislice: slice {self._syncer.slice_id} caught "
+                        f"up from snapshot round {adopted[0]} "
+                        f"(published by slice {adopted[1]})",
+                        file=sys.stderr,
+                    )
+                    self._ckpt_span(
+                        "sync_catchup", t0_wall, t0, int(self.state.step)
+                    )
+            self._syncer.attach(self.state)
         stop_sig = 0
         try:
             for epoch in range(start_epoch, cfg.train.epochs):
@@ -1280,6 +1365,18 @@ class Trainer:
                         # a (possibly minutes-long) save is NOT per-step
                         # host work: drop the tiling mark so the next
                         # step's dispatch never claims it
+                        prof_mark = None
+                    if (
+                        self._syncer is not None
+                        and cfg.sync.every_steps
+                        and res.steps % cfg.sync.every_steps == 0
+                    ):
+                        # the K-step scan-block boundary: exchange table
+                        # deltas with the other slices (AFTER the
+                        # checkpoint cadence, so a sync-round kill drill
+                        # leaves a boundary-committed checkpoint behind)
+                        run_sync_round()
+                        # a bounded wait is not per-step host work either
                         prof_mark = None
                     if kill_step and res.steps == kill_step:
                         # elastic-recovery drill (testing/faults.py):
@@ -1452,6 +1549,14 @@ class Trainer:
                     {"kind": "pipeline", "step": res.steps, **prec}
                 )
         res.seconds = time.perf_counter() - start
+        # final sync boundary: publish the tail block's delta and fold
+        # in whatever peers have landed, so the state this fit returns
+        # (and evaluates / checkpoints below) carries every slice's
+        # contribution. Skipped on preemption/halt — the grace window
+        # must not fund a bounded staleness wait; the rejoin snapshot
+        # path covers catch-up instead.
+        if self._syncer is not None and res.steps and not stop_sig and not halted:
+            run_sync_round()
         # table occupancy: fraction of slots ever touched by a gradient —
         # the sparse-model health metric (SURVEY.md §5 "table-occupancy").
         # FTRL's n accumulator (n>0 ⇔ slot was pushed) is the reliable
