@@ -105,6 +105,26 @@ SERVE_KEYS = (
 # means a pre-upgrade writer (or a mid-upgrade fleet mixing binaries),
 # not a schema violation — present they ride the all-or-none gate
 OPTIONAL_SERVE_KEYS = ("shed_requests",)
+# the key set every kind="autotune" decision record carries (serve
+# /autotune.py controller applied by server.ServeApp._autotune —
+# docs/OBSERVABILITY.md "SLO autotuning"); --check enforces
+# all-or-none, a known knob name, and monotone ts within a stream (one
+# controller = one replica = one ordered decision trail; out-of-order
+# ts means two controllers wrote one file)
+AUTOTUNE_KEYS = (
+    "knob",
+    "old",
+    "new",
+    "reason",
+    "slo_p99_ms",
+    "total_p99_ms",
+    "queue_wait_p99_ms",
+    "device_p99_ms",
+    "batch_fill",
+)
+# the only knobs the controller steers (autotune.AUTOTUNE_KNOBS is the
+# writer's copy) — an unknown name means a forged or drifted record
+AUTOTUNE_KNOB_NAMES = ("window_ms", "rung")
 # the key set every kind="pipeline" window record carries (telemetry
 # .PipelineProfiler.window_record + the trainer's step stamp —
 # docs/OBSERVABILITY.md "Input-pipeline attribution"); --check enforces
@@ -417,13 +437,15 @@ def check_fleet_identity(streams: dict) -> list[str]:
     """
     problems: list[str] = []
     # (run_id, rank) -> {replica stamps seen}, and per-(run_id, replica)
-    # the (ts, gen) trail. Span streams ride the same identity gates:
-    # "no span crosses replica stamps" is this one-stream-one-replica
-    # rule applied to kind="span".
+    # the (ts, gen) trail. Span and autotune streams ride the same
+    # identity gates: "no span crosses replica stamps" is this
+    # one-stream-one-replica rule applied to kind="span", and an
+    # autotune decision trail mixing replicas means two controllers
+    # steered one coalescer's record file.
     rank_replicas: dict = {}
     gen_trail: dict = {}
     for (run_id, rank, kind, gen), records in sorted(streams.items(), key=str):
-        if kind not in ("serve", "span"):
+        if kind not in ("serve", "span", "autotune"):
             continue
         reps = {
             r["replica"] for r in records
@@ -591,6 +613,8 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         last_round = 0  # sync streams: rounds count 1, 2, 3, ... within
         # a generation — a repeat or skip means a lost or forged record
         prev_live = None  # sync streams: membership ledger
+        last_at_ts = float("-inf")  # autotune streams: decision trail
+        # stays time-ordered (one controller per stream)
         for i, rec in enumerate(records, 1):
             for key in STAMP_KEYS:
                 if key not in rec:
@@ -737,6 +761,35 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                             f"({last_model_gen} -> {mg}) at record {i}"
                         )
                     last_model_gen = max(last_model_gen, mg)
+            if kind == "autotune":
+                a_present = [k for k in AUTOTUNE_KEYS if k in rec]
+                a_missing = [k for k in AUTOTUNE_KEYS if k not in rec]
+                if a_missing:
+                    problems.append(
+                        f"{tag}: record {i} has autotune keys "
+                        f"{a_present[:3]}... but lacks {a_missing}"
+                    )
+                    continue
+                if rec["knob"] not in AUTOTUNE_KNOB_NAMES:
+                    problems.append(
+                        f"{tag}: record {i} steers unknown knob "
+                        f"{rec['knob']!r} (known: "
+                        f"{', '.join(AUTOTUNE_KNOB_NAMES)})"
+                    )
+                if not (_finite(rec["old"]) and _finite(rec["new"])):
+                    problems.append(
+                        f"{tag}: record {i} has non-numeric old/new "
+                        "knob values"
+                    )
+                ts = rec.get("ts")
+                if _finite(ts):
+                    if ts < last_at_ts:
+                        problems.append(
+                            f"{tag}: decision ts went backwards "
+                            f"({last_at_ts} -> {ts}) at record {i} — "
+                            "two controllers wrote one stream?"
+                        )
+                    last_at_ts = max(last_at_ts, ts)
             if kind == "sync":
                 sy_missing = [k for k in SYNC_KEYS if k not in rec]
                 if sy_missing:
@@ -1169,6 +1222,9 @@ def render_health(streams: dict) -> str:
     serve_lines = render_serve_latency_split(streams, newest)
     if serve_lines:
         lines.extend(serve_lines)
+    at_lines = render_autotune_trajectory(streams, newest)
+    if at_lines:
+        lines.extend(at_lines)
     sync_lines = render_sync_staleness(streams, newest)
     if sync_lines:
         lines.extend(sync_lines)
@@ -1301,6 +1357,65 @@ def render_serve_latency_split(streams: dict, run_id: str) -> list[str]:
         )
     if out:
         out.insert(0, "  serving latency split (queue-wait vs device p99):")
+    return out
+
+
+def render_autotune_trajectory(streams: dict, run_id: str) -> list[str]:
+    """The SLO-autotuner verdict for the --health view (docs/SERVING.md
+    "Autotuning"): per controller stream, each knob's trajectory
+    (start -> end over N decisions) plus a one-word verdict — did the
+    closed loop CONVERGE (few direction reversals, settled), is it
+    OSCILLATING (the damping failed to kill a flip-flop between the
+    band edges), or is it PINNED AT FLOOR (the SLO is unattainable at
+    this load and the controller gave up shrinking — raise the SLO or
+    add replicas)? Empty when the run carries no autotune records
+    (serve.autotune off)."""
+    fmt = lambda v: f"{v:.4g}" if _finite(v) else "-"
+    out: list[str] = []
+    for (rid, rank, kind, gen), recs in sorted(streams.items(), key=str):
+        if kind != "autotune" or rid != run_id:
+            continue
+        decisions = [r for r in recs if "knob" in r]
+        if not decisions:
+            continue
+        rep = next(
+            (r["replica"] for r in decisions if _finite(r.get("replica"))),
+            None,
+        )
+        label = f"replica {rep}" if rep is not None else f"rank {rank}"
+        parts = []
+        verdict = "converged"
+        for knob in AUTOTUNE_KNOB_NAMES:
+            trail = [r for r in decisions if r.get("knob") == knob]
+            if not trail:
+                continue
+            signs = [
+                1 if r["new"] > r["old"] else -1
+                for r in trail
+                if _finite(r.get("old")) and _finite(r.get("new"))
+                and r["new"] != r["old"]
+            ]
+            reversals = sum(
+                1 for a, b in zip(signs, signs[1:]) if a != b
+            )
+            parts.append(
+                f"{knob} {fmt(trail[0]['old'])} -> {fmt(trail[-1]['new'])} "
+                f"({len(trail)} decision(s), {reversals} reversal(s))"
+            )
+            # oscillating: most moves undo the previous one — the
+            # damping never settled the loop inside the band
+            if len(signs) >= 4 and reversals > len(signs) // 2:
+                verdict = "oscillating"
+        if any(r.get("reason") == "floor_pinned" for r in decisions[-2:]):
+            verdict = "pinned at floor (SLO unattainable at this load)"
+        slo = decisions[-1].get("slo_p99_ms")
+        out.append(
+            f"    {label} gen {gen} (slo_p99_ms {fmt(slo)}): "
+            + "  ".join(parts)
+            + f"  [{verdict}]"
+        )
+    if out:
+        out.insert(0, "  autotune trajectory (kind=autotune):")
     return out
 
 

@@ -272,9 +272,15 @@ def normalize_lab(path: str, data) -> list[dict]:
 def normalize_serve(path: str, data) -> list[dict]:
     if not isinstance(data, dict) or "metric" not in data:
         return []
+    rnd = _round_of(path)
+    if rnd is None and _finite(data.get("round")):
+        # serve records without a round-numbered filename (the original
+        # BENCH_SERVE.json) may stamp the round themselves (serve_bench
+        # --round), joining the cross-round gate like any _rNN file
+        rnd = int(data["round"])
     entry = {
         "series": "serve",
-        "round": _round_of(path),
+        "round": rnd,
         "path": os.path.basename(path),
         "metric": data["metric"],
         "value": data.get("value"),
@@ -282,12 +288,36 @@ def normalize_serve(path: str, data) -> list[dict]:
     }
     for key in ("p50_ms", "p99_ms", "requests", "rows", "errors", "gen_flips",
                 "trace_sample_rate", "trace_overhead_pct", "qps_untraced",
-                "qps_traced"):
+                "qps_traced", "slo_ms", "slo_attainment_pct"):
         if _finite(data.get(key)):
             entry[key] = data[key]
     if isinstance(data.get("traced"), bool):
         entry["traced"] = data["traced"]
-    return [entry]
+    out = [entry]
+    # the latency leg gates as its OWN group, downward (the _ms suffix
+    # flips `_lower_is_better`): a round that doubles QPS by letting the
+    # tail blow out is a regression, not a win — p99-at-SLO and QPS gate
+    # together. Named off the record's metric so BENCH_SERVE /
+    # BENCH_SERVE_FLEET / BENCH_TRACE rounds never cross-gate.
+    if _finite(data.get("p99_ms")):
+        out.append({
+            "series": "serve",
+            "round": rnd,
+            "path": os.path.basename(path),
+            "metric": f"{data['metric']}_p99_ms",
+            "value": data["p99_ms"],
+            "unit": "ms",
+        })
+    if _finite(data.get("slo_attainment_pct")):
+        out.append({
+            "series": "serve",
+            "round": rnd,
+            "path": os.path.basename(path),
+            "metric": f"{data['metric']}_slo_attainment_pct",
+            "value": data["slo_attainment_pct"],
+            "unit": "%",
+        })
+    return out
 
 
 def collect(root: str, extra: list[str]) -> list[dict]:

@@ -59,11 +59,25 @@ class UnixHTTPConnection(http.client.HTTPConnection):
         self.sock.connect(self._path)
 
 
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    """TCP_NODELAY on connect: a loadgen measuring tail latency must
+    not let Nagle batch its own requests — without it, any send that
+    straddles two segments waits on the server's delayed ACK (~40 ms
+    on loopback), which would be charged to the server's p99."""
+
+    def connect(self):
+        super().connect()
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
 def _connect(args):
     if args.unix:
         return UnixHTTPConnection(args.unix, timeout=args.timeout)
     host, _, port = args.url.rpartition("//")[2].partition(":")
-    return http.client.HTTPConnection(
+    return _NoDelayHTTPConnection(
         host or "127.0.0.1", int(port or 80), timeout=args.timeout
     )
 
@@ -280,6 +294,11 @@ class Client:
         router — one trace id, one root span) and the final response's
         echo is verified against what was sent."""
         a = self._args
+        if isinstance(body, str):
+            # bytes bodies ride http.client's single-sendall path
+            # (headers + body in one segment); a str body is sent as a
+            # second send() and Nagle holds it for the delayed ACK
+            body = body.encode("utf-8")
         t0 = time.perf_counter()
         budget = a.deadline_ms / 1e3 if a.deadline_ms > 0 else float("inf")
         retries_used = 0
@@ -362,6 +381,16 @@ def percentile(xs: list, q: float) -> float:
     return xs[idx]
 
 
+def slo_attainment_pct(latencies_s: list, slo_ms: float) -> float:
+    """Share (0..100) of successful requests answered within `slo_ms`.
+    Empty = 0.0 — a run that answered nothing attained nothing (the
+    --min-attainment gate must fail it, not divide by zero)."""
+    if not latencies_s:
+        return 0.0
+    n = sum(1 for lat in latencies_s if lat * 1e3 <= slo_ms)
+    return round(100.0 * n / len(latencies_s), 2)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="loadgen for `xflow serve`")
     ap.add_argument("--url", default="http://127.0.0.1:8000")
@@ -400,6 +429,19 @@ def main(argv=None) -> int:
                          "drove (stamped into the bench record so the "
                          "BENCH_TRACE trajectory notes tracing overhead; "
                          "> 0 implies --trace)")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="the serving SLO this run is judged against: "
+                         "stamp slo_attainment_pct (share of successful "
+                         "requests answered within this many ms) into the "
+                         "bench record (0 = no SLO accounting)")
+    ap.add_argument("--min-attainment", type=float, default=0.0,
+                    help="with --slo-ms: exit nonzero when "
+                         "slo_attainment_pct lands below this percentage "
+                         "(the CI attainment gate; 0 = report only)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="perf-ledger round to stamp into the record "
+                         "(tools/perf_ledger.py reads it when the filename "
+                         "carries no _rNN suffix)")
     ap.add_argument("--bench-json", default="",
                     help="write a BENCH-style serve perf JSON here ('-' = stdout)")
     args = ap.parse_args(argv)
@@ -466,11 +508,30 @@ def main(argv=None) -> int:
         "gen_flips": max(len(gens) - 1, 0),
         "steps": sorted(stats.steps),
     }
+    attainment = None
+    if args.slo_ms > 0:
+        # the SLO trail (docs/SERVING.md "Autotuning"): which target the
+        # run was judged against and what share of answers met it — the
+        # per-request truth the p99-at-SLO ledger groups summarize
+        attainment = slo_attainment_pct(lat, args.slo_ms)
+        rec["slo_ms"] = args.slo_ms
+        rec["slo_attainment_pct"] = attainment
+    if args.round is not None:
+        rec["round"] = args.round
     out = json.dumps(rec)
     print(out)  # the one JSON line consumers parse
     if args.bench_json and args.bench_json != "-":  # '-' already printed
         with open(args.bench_json, "w") as f:
             f.write(out + "\n")
+    if (args.min_attainment > 0 and attainment is not None
+            and attainment < args.min_attainment):
+        print(
+            f"serve_bench: SLO attainment {attainment}% < "
+            f"--min-attainment {args.min_attainment}% "
+            f"(slo {args.slo_ms} ms)",
+            file=sys.stderr,
+        )
+        return 1
     # an echo miss is a FAILED round trip even when the predict
     # succeeded — the trace id is the join key the whole layer is for
     return 1 if (stats.errors or stats.trace_echo_miss) else 0

@@ -485,6 +485,33 @@ class ServeConfig:
     brownout_low_frac: float = 0.25
     brownout_after_s: float = 0.25
     brownout_window_factor: float = 0.25
+    # ---- SLO autotuning (serve/autotune.py, docs/SERVING.md
+    # "Autotuning") ----------------------------------------------------
+    # closed-loop controller: each flushed telemetry window's queue-wait
+    # vs device p99 decomposition steers window_ms (and the ladder rung)
+    # toward slo_p99_ms. Off (default) leaves every knob exactly where
+    # the config put it — the serve stream is byte-identical to a
+    # pre-autotune build (pinned by test, like trace_sample_rate=0).
+    autotune: bool = False
+    # the total-latency p99 target the controller steers toward (ms)
+    slo_p99_ms: float = 25.0
+    # hysteresis band: no decision while total_p99 is within
+    # slo * (1 ± band_frac) — the controller converges instead of
+    # chasing window-to-window noise
+    autotune_band_frac: float = 0.15
+    # initial multiplicative step per decision; every direction
+    # reversal halves the knob's step (damping), so an overshoot
+    # cannot oscillate at constant amplitude
+    autotune_step_frac: float = 0.5
+    # window_ms floor: asked to shrink below it, the controller pins
+    # there and emits ONE floor_pinned warning (unattainable SLO must
+    # not flap the knob every window)
+    autotune_min_window_ms: float = 0.25
+    # precompiled batch-shape ladder ("16,64,256"; "" = max_batch
+    # only): every rung AOT-compiles at startup and each batch flushes
+    # at the smallest rung that fits, so small batches stop paying
+    # full-max_batch padding. max_batch always joins as the top rung.
+    ladder: str = ""
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,265 @@
+"""Closed-loop SLO autotuning: serve telemetry drives the coalescer.
+
+PR 9 built the measurement half of serving observability — every
+flushed kind="serve" window decomposes the latency budget into
+queue-wait (coalescing delay) vs device (predict step) p50/p99. This
+module closes the loop: `AutotuneController` consumes each flushed
+window and steers the coalescer toward `serve.slo_p99_ms`, the same
+design lesson the reference's async workers carry (a fixed global
+cadence cannot match a changing load — the batching cadence must
+adapt):
+
+- **queue-wait dominates while over the SLO** -> the coalescing window
+  is the latency: shrink `window_ms` (multiplicative, damped).
+- **device dominates while over the SLO** -> the batch shape is the
+  latency: step the active ladder rung DOWN (smaller padded batches).
+- **device dominates while under the SLO** -> there is headroom to
+  amortize: grow `window_ms` (bigger batches, fewer device calls),
+  after restoring any previously lowered rung.
+- **inside the hysteresis band** -> no decision. The band plus
+  step-size damping (every direction reversal halves the knob's step)
+  makes the controller converge instead of flapping.
+- **unattainable SLO** -> the controller pins at the window floor and
+  emits ONE `floor_pinned` warning decision, then stays quiet until
+  load changes direction (docs/SERVING.md failure matrix).
+
+The batch-shape ladder (`parse_ladder`/`pick_rung`) is the second half
+of the tentpole: instead of one padded `[max_batch, max_nnz]` program,
+`serve.ladder` names a rung set (e.g. "16,64,256") that the runner
+AOT-compiles at startup (one CompileRecorder program per rung, so the
+exactly-once compile gate stays green per rung) and each device batch
+flushes at the smallest rung that fits — small batches stop paying
+full-batch padding, and the controller can move the release rung.
+
+Everything is clock-injectable and socket-free: the device worker
+(serve/server.py) feeds `observe()` the window records ServeMetrics
+returns from `maybe_flush`, applies the returned decisions to the
+MicroBatcher, and ships each as a stamped kind="autotune" JSONL record
+plus an operational span (visible in `request_trace.py --timeline`,
+audited by `metrics_report --check`). `/stats` serves `state()`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Optional
+
+if TYPE_CHECKING:  # config type only — no runtime import cycle
+    from xflow_tpu.config import ServeConfig
+
+# the knob vocabulary (metrics_report --check rejects records naming
+# any other knob; keep docs/OBSERVABILITY.md in sync)
+AUTOTUNE_KNOBS = ("window_ms", "rung")
+
+# decision reasons (documented in docs/OBSERVABILITY.md; the --health
+# verdict reads floor_pinned as the unattainable-SLO signal)
+REASONS = (
+    "queue_dominated",   # over SLO, queue-wait dominates: window shrinks
+    "device_dominated",  # over SLO, device dominates: rung steps down
+    "device_headroom",   # under SLO, device dominates: window grows
+    "rung_restore",      # under SLO: a previously lowered rung steps up
+    "floor_pinned",      # over SLO at the window floor: pin + ONE warning
+)
+
+# damping never erases a knob's step entirely — a later load change
+# must still be able to move it
+MIN_STEP_FRAC = 0.02
+
+
+def parse_ladder(scfg: "ServeConfig") -> tuple:
+    """`serve.ladder` ("16,64,256") -> ascending rung tuple.
+
+    Rungs above `serve.max_batch` clamp to it; `serve.max_batch` always
+    joins as the top rung (the compiled shape every request is promised
+    to fit); "" (default) = the single max_batch rung — exactly the
+    pre-ladder behavior. Raises ValueError on a non-positive or
+    non-integer rung: a typo'd ladder must fail startup, not serve."""
+    top = int(scfg.max_batch)
+    rungs = {top}
+    text = str(scfg.ladder).strip()
+    if text:
+        for tok in text.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            try:
+                r = int(tok)
+            except ValueError:
+                raise ValueError(
+                    f"serve.ladder: rung {tok!r} is not an integer"
+                ) from None
+            if r <= 0:
+                raise ValueError(f"serve.ladder: rung {r} must be >= 1")
+            rungs.add(min(r, top))
+    return tuple(sorted(rungs))
+
+
+def pick_rung(n_rows: int, rungs: tuple) -> int:
+    """The smallest rung that fits `n_rows` (the top rung otherwise —
+    the batcher never releases a group beyond max_batch rows)."""
+    for r in rungs:
+        if n_rows <= r:
+            return r
+    return rungs[-1]
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One knob move: `knob` steps `old` -> `new` because `reason`.
+    `old == new` only for the floor_pinned warning (the pin itself is
+    the information; the knob did not move)."""
+
+    knob: str
+    old: float
+    new: float
+    reason: str
+
+
+class AutotuneController:
+    """The SLO controller. `observe(window)` -> [Decision] runs on the
+    device-worker thread (serve/server.py applies the decisions);
+    `state()` snapshots for `/stats` on HTTP handler threads — the lock
+    covers exactly that cross-thread read. `clock` is injectable so
+    tests script time like the MicroBatcher's."""
+
+    def __init__(
+        self,
+        scfg: "ServeConfig",
+        rungs: Optional[tuple] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.slo_ms = float(scfg.slo_p99_ms)
+        if self.slo_ms <= 0:
+            raise ValueError(
+                f"serve.slo_p99_ms={self.slo_ms}: the autotuner needs a "
+                "positive latency target"
+            )
+        self.band_frac = max(float(scfg.autotune_band_frac), 0.0)
+        self.min_window_ms = max(float(scfg.autotune_min_window_ms), 0.0)
+        # the growth ceiling is derived, not another knob: a coalescing
+        # delay equal to the whole p99 budget is already unserveable
+        self.max_window_ms = max(self.slo_ms, self.min_window_ms)
+        self.rungs = tuple(rungs) if rungs else parse_ladder(scfg)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.window_ms = float(scfg.window_ms)
+        self.rung = self.rungs[-1]
+        step0 = min(max(float(scfg.autotune_step_frac), MIN_STEP_FRAC), 0.9)
+        self._step = {"window_ms": step0, "rung": step0}
+        self._last_dir = {"window_ms": 0, "rung": 0}
+        self._floor_warned = False
+        self.windows_seen = 0
+        self.decision_count = 0
+        self._last_decision_t: Optional[float] = None
+
+    # ------------------------------------------------------------ policy
+    def _damped(self, knob: str, direction: int) -> float:
+        """Advance the knob's damping state and return its current
+        step fraction: a direction reversal halves the step (floored),
+        a same-direction move keeps it — overshoots decay."""
+        prev = self._last_dir[knob]
+        if prev != 0 and prev != direction:
+            self._step[knob] = max(self._step[knob] * 0.5, MIN_STEP_FRAC)
+        self._last_dir[knob] = direction
+        return self._step[knob]
+
+    def _rung_step(self, up: bool) -> Optional[Decision]:
+        i = self.rungs.index(self.rung)
+        j = i + 1 if up else i - 1
+        if j < 0 or j >= len(self.rungs):
+            return None
+        old, self.rung = self.rung, self.rungs[j]
+        self._damped("rung", 1 if up else -1)
+        return Decision(
+            knob="rung", old=float(old), new=float(self.rung),
+            reason="rung_restore" if up else "device_dominated",
+        )
+
+    def observe(self, window: dict) -> list:
+        """One flushed kind="serve" window record -> the decisions it
+        justifies (possibly empty). The caller applies them to the
+        batcher and ships the telemetry."""
+        p99 = window.get("total_p99_ms")
+        qw = window.get("queue_wait_p99_ms")
+        dev = window.get("device_p99_ms")
+        if p99 is None or qw is None or dev is None:
+            return []  # a window without latency evidence steers nothing
+        with self._lock:
+            self.windows_seen += 1
+            decisions = self._steer_locked(float(p99), float(qw), float(dev))
+            if decisions:
+                self.decision_count += len(decisions)
+                self._last_decision_t = self._clock()
+            return decisions
+
+    def _steer_locked(self, p99: float, qw: float, dev: float) -> list:
+        hi = self.slo_ms * (1.0 + self.band_frac)
+        lo = self.slo_ms * (1.0 - self.band_frac)
+        if p99 > hi:
+            if qw >= dev:
+                return self._shrink_window_locked()
+            d = self._rung_step(up=False)
+            if d is not None:
+                return [d]
+            # already at the bottom rung: the window is the only lever
+            return self._shrink_window_locked()
+        if p99 < lo:
+            # headroom: restore a previously lowered rung first (the
+            # cheap, exactly-reversible move), then amortize the device
+            if self.rung != self.rungs[-1]:
+                d = self._rung_step(up=True)
+                return [d] if d is not None else []
+            if dev >= qw:
+                return self._grow_window_locked()
+        return []  # inside the hysteresis band: converged, hold
+
+    def _shrink_window_locked(self) -> list:
+        if self.window_ms <= self.min_window_ms:
+            if self._floor_warned:
+                return []  # pinned: warn once, never flap
+            self._floor_warned = True
+            v = self.window_ms
+            return [Decision(knob="window_ms", old=v, new=v,
+                             reason="floor_pinned")]
+        step = self._damped("window_ms", -1)
+        old = self.window_ms
+        self.window_ms = max(old * (1.0 - step), self.min_window_ms)
+        return [Decision(knob="window_ms", old=old, new=self.window_ms,
+                         reason="queue_dominated")]
+
+    def _grow_window_locked(self) -> list:
+        if self.window_ms >= self.max_window_ms:
+            return []
+        step = self._damped("window_ms", +1)
+        old = self.window_ms
+        self.window_ms = min(old * (1.0 + step), self.max_window_ms)
+        # growth means the floor episode (if any) ended: a NEW
+        # unattainable stretch warns again
+        self._floor_warned = False
+        return [Decision(knob="window_ms", old=old, new=self.window_ms,
+                         reason="device_headroom")]
+
+    # ------------------------------------------------------------- state
+    def state(self) -> dict:
+        """Live controller state for `GET /stats` (and tests)."""
+        with self._lock:
+            last = self._last_decision_t
+            return {
+                "slo_p99_ms": self.slo_ms,
+                "band_frac": self.band_frac,
+                "window_ms": round(self.window_ms, 4),
+                "min_window_ms": self.min_window_ms,
+                "rung": self.rung,
+                "rungs": list(self.rungs),
+                "windows_seen": self.windows_seen,
+                "decisions": self.decision_count,
+                "floor_pinned": self._floor_warned,
+                "step_frac": {k: round(v, 4)
+                              for k, v in self._step.items()},
+                "since_last_decision_s": (
+                    round(self._clock() - last, 3)
+                    if last is not None else None
+                ),
+            }

@@ -116,6 +116,12 @@ class MicroBatcher:
             raise ValueError(f"max_rows={max_rows}: need >= 1")
         self.max_rows = int(max_rows)
         self.window_s = float(window_s)
+        # release target (<= max_rows): the rows that trigger a size
+        # flush and cap a popped group. Distinct from max_rows — the
+        # autotuner moves THIS (the active ladder rung) while the
+        # per-request row cap (and the compiled top-rung shape) stays
+        # max_rows, so no in-flight client contract changes under it.
+        self._release_rows = int(max_rows)
         self.max_queue_rows = int(max_queue_rows)
         self._clock = clock
         self._lock = threading.Lock()
@@ -190,6 +196,27 @@ class MicroBatcher:
         deadline flush against the window that actually applied)."""
         with self._lock:
             return self._effective_window_locked()
+
+    @property
+    def release_rows(self) -> int:
+        with self._lock:
+            return self._release_rows
+
+    # ------------------------------------------------- autotuner setters
+    # (serve/autotune.py): the controller runs on the device-worker
+    # thread while submit/take touch the same fields from handler
+    # threads — both setters hold the lock and wake the worker, since a
+    # shrink can make the oldest queued request releasable RIGHT NOW
+    def set_window_s(self, window_s: float) -> None:
+        with self._lock:
+            self.window_s = max(float(window_s), 0.0)
+            self._cv.notify_all()
+
+    def set_release_rows(self, rows: int) -> None:
+        """Move the active release rung; clamped to [1, max_rows]."""
+        with self._lock:
+            self._release_rows = max(1, min(int(rows), self.max_rows))
+            self._cv.notify_all()
 
     def submit(self, fields_rows: list, slots_rows: list,
                priority: int = 0, trace: str = "", span: str = "") -> Future:
@@ -266,7 +293,7 @@ class MicroBatcher:
                 if self._q:
                     flush_at = self._q[0].t_submit + self._effective_window_locked()
                     if (
-                        self._queued_rows >= self.max_rows
+                        self._queued_rows >= self._release_rows
                         or now >= flush_at
                         or self._closed
                     ):
@@ -298,9 +325,15 @@ class MicroBatcher:
         return group
 
     def _pop_group_locked(self) -> list:
+        # cap at the release rung, but ALWAYS pop the head request: a
+        # request legitimately bigger than the current rung (but within
+        # max_rows, the submit contract) releases alone and simply
+        # assembles at the next rung that fits — never wedges the queue
+        cap = max(self._release_rows,
+                  self._q[0].num_rows if self._q else 0)
         group = []
         rows = 0
-        while self._q and rows + self._q[0].num_rows <= self.max_rows:
+        while self._q and rows + self._q[0].num_rows <= cap:
             req = self._q.popleft()
             rows += req.num_rows
             group.append(req)

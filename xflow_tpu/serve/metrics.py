@@ -99,6 +99,7 @@ class ServeMetrics:
         self._requests = 0
         self._rows = 0
         self._batches = 0
+        self._capacity = 0  # sum of per-batch padded shapes (ladder rungs)
         self._bad = 0
         self._shed = 0
         self._queue_waits: list = []
@@ -113,11 +114,17 @@ class ServeMetrics:
         queue_waits_s: list,
         device_s: float,
         totals_s: list,
+        batch_size: Optional[int] = None,
     ) -> None:
+        """`batch_size` is the PADDED shape this batch shipped at — the
+        ladder rung (serve/autotune.py). None (the pre-ladder callers)
+        falls back to the constructor's fixed batch size, so batch_fill
+        keeps meaning rows/padded-capacity either way."""
         with self._lock:
             self._requests += n_requests
             self._rows += n_rows
             self._batches += 1
+            self._capacity += int(batch_size) if batch_size else self._batch_size
             self._queue_waits.extend(queue_waits_s)
             self._device.append(device_s)
             self._totals.extend(totals_s)
@@ -182,9 +189,7 @@ class ServeMetrics:
                 "qps": round(self._requests / max(elapsed, 1e-9), 2),
                 "rows_per_s": round(self._rows / max(elapsed, 1e-9), 1),
                 "batches": self._batches,
-                "batch_fill": round(
-                    self._rows / max(self._batches * self._batch_size, 1), 4
-                ),
+                "batch_fill": round(self._rows / max(self._capacity, 1), 4),
                 "queue_wait_p50_ms": pct(self._queue_waits, 50),
                 "queue_wait_p99_ms": pct(self._queue_waits, 99),
                 "device_p50_ms": pct(self._device, 50),

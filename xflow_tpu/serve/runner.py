@@ -130,12 +130,33 @@ class ServeRunner:
             self._put = lambda arrays: {
                 k: jax.device_put(np.asarray(v), bsh[k]) for k, v in arrays.items()
             }
+            # the batch-shape ladder is solo-only: a sharded eval step's
+            # batch axis must divide the mesh, so the mesh path keeps
+            # its single [max_batch] program (docs/SERVING.md)
+            self.rungs = (int(cfg.serve.max_batch),)
+            self._predict_steps = {self.rungs[0]: self._predict_step}
         else:
             from xflow_tpu.models.predict import make_predict_fn
+            from xflow_tpu.serve.autotune import parse_ladder
 
-            self._predict_step = make_predict_fn(
-                self.model, cfg, recorder=recorder, name="predict.serve"
-            )
+            # the precompiled batch-shape ladder (serve/autotune.py):
+            # one jitted program PER rung, each with its own program
+            # name so compile accounting stays exactly-once per
+            # (program, signature). An unconfigured ladder collapses to
+            # the single "predict.serve" program — byte-identical
+            # compile records to the pre-ladder build.
+            self.rungs = parse_ladder(cfg.serve)
+            if len(self.rungs) == 1:
+                names = {self.rungs[0]: "predict.serve"}
+            else:
+                names = {r: f"predict.serve.b{r}" for r in self.rungs}
+            self._predict_steps = {
+                r: make_predict_fn(
+                    self.model, cfg, recorder=recorder, name=names[r]
+                )
+                for r in self.rungs
+            }
+            self._predict_step = self._predict_steps[self.rungs[-1]]
             import jax
 
             self._put = jax.device_put
@@ -272,8 +293,28 @@ class ServeRunner:
         gen = self._gen
         if gen is None:
             raise RuntimeError("no checkpoint loaded; call load() first")
-        p = self._predict_step(gen.tables, self._put(arrays))
+        # ladder dispatch: the batch's leading dim picks its rung's
+        # compiled program; an off-ladder shape (direct predict()
+        # callers) falls back to jit's own shape specialization
+        fn = self._predict_steps.get(
+            int(arrays["slots"].shape[0]), self._predict_step
+        )
+        p = fn(gen.tables, self._put(arrays))
         return np.asarray(p), gen
+
+    def warmup(self) -> int:
+        """AOT-compile every ladder rung before traffic arrives: one
+        all-padding batch per rung through the real predict path, so
+        the first real request at any rung never pays its compile.
+        Returns the number of rungs warmed (serve_main logs it)."""
+        from xflow_tpu.serve.coalescer import assemble_batch
+
+        if self._gen is None:
+            raise RuntimeError("no checkpoint loaded; call load() first")
+        for r in self.rungs:
+            arrays, _ = assemble_batch([], r, self.cfg.data.max_nnz)
+            self.predict(arrays)
+        return len(self.rungs)
 
     def predict_rows(self, rows: list) -> tuple[np.ndarray, Generation]:
         """Convenience (C API / tests): parse + pad + predict a list of

@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from xflow_tpu.config import Config
+from xflow_tpu.serve.autotune import AutotuneController, pick_rung
 from xflow_tpu.serve.coalescer import (
     BrownoutPolicy,
     MicroBatcher,
@@ -49,6 +50,7 @@ from xflow_tpu.tracing import (
     TRACE_HEADER,
     Tracer,
     clean_id,
+    emit_op_span,
     new_id,
 )
 
@@ -101,6 +103,20 @@ class ServeApp:
             brownout=BrownoutPolicy.from_config(scfg),
             on_brownout=on_brownout,
         )
+        # the batch-shape ladder + SLO controller (serve/autotune.py):
+        # each flushed metrics window the worker loop feeds the
+        # controller steers window_ms / the release rung toward
+        # serve.slo_p99_ms. Off (default) = no controller object, no
+        # autotune records/spans, rung == max_batch everywhere — the
+        # stream stays byte-identical to a pre-autotune build.
+        self._rungs = tuple(getattr(runner, "rungs", ())) or (
+            int(scfg.max_batch),
+        )
+        self.autotuner = (
+            AutotuneController(scfg, rungs=self._rungs)
+            if scfg.autotune
+            else None
+        )
         self._timeout_s = scfg.request_timeout_s
         self._stop = threading.Event()
         self._worker = threading.Thread(
@@ -125,19 +141,24 @@ class ServeApp:
             if group is None:
                 if self._stop.is_set():
                     return
-                # idle tick: windows still flush on schedule
+                # idle tick: windows still flush on schedule (and a
+                # window that flushes here still steers the controller)
                 gen = self.runner.generation
                 if gen is not None:
-                    self.metrics.maybe_flush(gen.gen, gen.step)
+                    self._autotune(self.metrics.maybe_flush(gen.gen, gen.step))
                 continue
             t_batch = time.perf_counter()
             if self._fault_delay_s > 0:
                 # slow-replica injector: the device "runs slow" without
                 # real overload — circuit/hedge drills use this
                 time.sleep(self._fault_delay_s)
+            # flush at the smallest precompiled rung that fits — small
+            # batches stop paying full-max_batch padding (the single
+            # unconfigured rung IS max_batch, the pre-ladder shape)
+            rung = pick_rung(sum(r.num_rows for r in group), self._rungs)
             try:
                 arrays, spans = assemble_batch(
-                    group, cfg.serve.max_batch, cfg.data.max_nnz
+                    group, rung, cfg.data.max_nnz
                 )
                 # predict's np.asarray readback IS the device sync: the
                 # worker (not the handler threads) pays the batch's
@@ -151,7 +172,7 @@ class ServeApp:
                 continue
             t_done = time.perf_counter()
             device_s = t_done - t_batch
-            self._trace_batch(group, spans, t_batch, t_done, gen)
+            self._trace_batch(group, spans, t_batch, t_done, gen, rung)
             queue_waits, totals = [], []
             n_rows = 0
             for req, lo, hi in spans:
@@ -168,9 +189,10 @@ class ServeApp:
                     }
                 )
             self.metrics.observe_batch(
-                len(group), n_rows, queue_waits, device_s, totals
+                len(group), n_rows, queue_waits, device_s, totals,
+                batch_size=rung,
             )
-            self.metrics.maybe_flush(gen.gen, gen.step)
+            self._autotune(self.metrics.maybe_flush(gen.gen, gen.step))
             self._batches_served += 1
             if (
                 self._fault_kill_batches
@@ -184,8 +206,44 @@ class ServeApp:
 
                 hard_kill()
 
+    # ----------------------------------------------------------- autotune
+    def _autotune(self, window: Optional[dict]) -> None:
+        """Feed one flushed metrics window to the SLO controller and
+        apply + publish its decisions. Every decision ships as a
+        stamped kind="autotune" record (the audit trail metrics_report
+        gates) plus an operational span carrying the same knob move, so
+        `request_trace --timeline` overlays the controller's actions on
+        the latency spans they caused. No-op when autotune is off or
+        the window didn't flush."""
+        if window is None or self.autotuner is None:
+            return
+        t0_wall, t0 = time.time(), time.perf_counter()
+        for d in self.autotuner.observe(window):
+            if d.knob == "window_ms" and d.new != d.old:
+                self.batcher.set_window_s(d.new / 1e3)
+            elif d.knob == "rung" and d.new != d.old:
+                self.batcher.set_release_rows(int(d.new))
+            self.metrics.appender.append({
+                "kind": "autotune",
+                "knob": d.knob,
+                "old": round(d.old, 4),
+                "new": round(d.new, 4),
+                "reason": d.reason,
+                "slo_p99_ms": self.autotuner.slo_ms,
+                "total_p99_ms": window["total_p99_ms"],
+                "queue_wait_p99_ms": window["queue_wait_p99_ms"],
+                "device_p99_ms": window["device_p99_ms"],
+                "batch_fill": window["batch_fill"],
+            })
+            emit_op_span(
+                self.metrics.appender, "autotune", t0_wall,
+                time.perf_counter() - t0,
+                knob=d.knob, old=round(d.old, 4), new=round(d.new, 4),
+                reason=d.reason,
+            )
+
     # ------------------------------------------------------------- tracing
-    def _trace_batch(self, group, spans, t_batch, t_done, gen) -> None:
+    def _trace_batch(self, group, spans, t_batch, t_done, gen, rung) -> None:
         """Emit the shared device_batch span + each traced member's
         queue/device spans (the batch-membership link: N request trees
         reference ONE batch span by id). Zero-cost when tracing is off
@@ -216,7 +274,9 @@ class ServeApp:
             "dur_ms": round((t_done - t_batch) * 1e3, 3),
             "requests": len(spans),
             "rows": n_rows,
-            "batch_fill": round(n_rows / max(self.cfg.serve.max_batch, 1), 4),
+            # fill against the rung this batch actually shipped at (the
+            # single unconfigured rung is max_batch — same value)
+            "batch_fill": round(n_rows / max(rung, 1), 4),
             "flush": flush,
             "generation": gen.gen,
         }
@@ -336,7 +396,13 @@ class ServeApp:
     def stats(self) -> dict:
         from xflow_tpu.telemetry import default_registry
 
-        return {**self.health(), "registry": default_registry().snapshot()}
+        out = {**self.health(), "registry": default_registry().snapshot()}
+        if self.autotuner is not None:
+            # live controller state (docs/SERVING.md "Autotuning"):
+            # absent entirely when autotune is off, so off-mode /stats
+            # responses stay shape-identical to a pre-autotune build
+            out["autotune"] = self.autotuner.state()
+        return out
 
     def close(self) -> None:
         """Graceful: stop intake, drain the backlog (every queued
@@ -355,6 +421,20 @@ def _make_handler(app: ServeApp):
         # the loadgen's closed loop connection-reuse instead of
         # connect-per-request
         protocol_version = "HTTP/1.1"
+        # buffered wfile: headers + body leave in ONE segment (the
+        # stdlib default wbufsize=0 writes them separately, and Nagle
+        # holds the body until the headers are ACKed — with the peer's
+        # delayed ACK that is a ~40 ms stall per response on loopback)
+        wbufsize = -1
+
+        def setup(self):
+            super().setup()
+            try:
+                self.connection.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass  # AF_UNIX transport: no Nagle to disable
 
         def _reply(self, status: int, payload: dict, trace: str = "") -> None:
             data = json.dumps(payload).encode("utf-8")
@@ -490,6 +570,14 @@ def serve_main(cfg: Config, mesh=None, ready_out=None) -> int:
         # stream (request_trace --timeline overlays them); off when
         # tracing is off so rate-0 streams stay byte-identical
         runner.span_sink = app.metrics.appender
+    if cfg.serve.autotune or len(getattr(runner, "rungs", ())) > 1:
+        # AOT-compile the whole ladder BEFORE the ready line: the
+        # controller must be able to move the rung without the first
+        # batch at a new shape paying its compile on the latency path.
+        # Unladdered autotune-off servers keep the lazy first-batch
+        # compile, byte-identical to the pre-ladder build.
+        n = runner.warmup()
+        print(f"serve: precompiled {n} ladder rung(s)", file=sys.stderr)
     app.metrics.event("start", generation=gen.gen, step=gen.step)
     try:
         # the fleet's staggered-reload offset (serve/fleet.py exports
