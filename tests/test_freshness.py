@@ -1,0 +1,502 @@
+"""Freshness observability: the stream -> train -> publish -> serve
+loop (docs/SERVING.md "Freshness", docs/DATA.md "Streaming source").
+
+Covers the tail follower's sealing discipline (deferred truncated
+tails, rotation, convert-on-arrival), the publication sidecar
+round-trip, the serve-side closure (Generation.freshness_s with fake
+clocks, the data_freshness_s window key), the metrics_report
+ingest/publish/freshness schema gates, the freshness_report Δ
+assembly + gate, the perf-ledger `fresh` series direction, the
+zero-overhead-when-off pin (data.stream=off / publish_every=0 leaves
+every stream and checkpoint byte-identical to a pre-freshness build),
+and — slow-marked — the live end-to-end drill (tools/smoke_fresh.sh)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.data.pipeline import TailFollower, stream_dir_for
+from xflow_tpu.data.synth import generate_shards
+from xflow_tpu.metrics import BucketAUC
+from xflow_tpu.train import checkpoint as ckpt
+from xflow_tpu.train.trainer import Trainer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LINE = "1 0:3:1.0 1:7:1.0 2:9:1.0 3:2:1.0 4:5:1.0 5:8:1.0\n"
+LINE0 = "0 0:4:1.0 1:6:1.0 2:1:1.0 3:3:1.0 4:2:1.0 5:9:1.0\n"
+
+
+class _App:
+    """Duck-typed appender capturing what the follower stamps."""
+
+    def __init__(self):
+        self.recs = []
+
+    def append(self, rec):
+        self.recs.append(dict(rec))
+
+
+def _data_cfg(tmp_path, **kw):
+    base = {
+        "data.cache": "off",
+        "data.stream": "tail",
+        "data.stream_poll_s": 0.01,
+        "data.stream_idle_s": 0.2,
+        "data.stream_dir": str(tmp_path / "spool"),
+        "model.num_fields": 6,
+        "data.max_nnz": 8,
+    }
+    base.update(kw)
+    return override(Config(), **base).data
+
+
+# ------------------------------------------------------------ tail follower
+
+
+def test_tail_follower_defers_truncated_tail(tmp_path):
+    src = tmp_path / "shard"
+    src.write_text(LINE + LINE0[:-1])  # second row mid-append, no newline
+    app = _App()
+    f = TailFollower(str(src), _data_cfg(tmp_path), appender=app)
+    segs = f.poll()
+    # only the COMPLETED line seals; the torn tail is deferred (a
+    # writer mid-append is normal), never quarantined
+    assert len(segs) == 1 and segs[0].rows == 1
+    assert segs[0].offset == 0 and segs[0].bytes == len(LINE)
+    assert f.poll() == []  # still torn: nothing new
+    with open(src, "a") as fh:
+        fh.write("\n")  # the writer finishes the row
+    segs2 = f.poll()
+    assert len(segs2) == 1 and segs2[0].rows == 1
+    assert segs2[0].offset == len(LINE)
+    # segments are immutable spool files stamped with distinct traces
+    assert segs[0].trace != segs2[0].trace
+    assert segs2[0].seq == segs[0].seq + 1
+    assert open(segs2[0].path).read() == LINE0
+    # and each seal landed a kind="ingest" record with the full key set
+    assert [r["kind"] for r in app.recs] == ["ingest", "ingest"]
+    for r in app.recs:
+        for key in ("trace", "seq", "source", "offset", "rows", "bytes",
+                    "cache", "ingest_ts"):
+            assert key in r
+
+
+def test_tail_follower_rotation_restarts_from_top(tmp_path):
+    src = tmp_path / "shard"
+    src.write_text(LINE * 3)
+    f = TailFollower(str(src), _data_cfg(tmp_path))
+    assert f.poll()[0].rows == 3
+    src.write_text(LINE0)  # rotated/recreated: SMALLER than the offset
+    segs = f.poll()
+    assert len(segs) == 1 and segs[0].offset == 0
+    assert open(segs[0].path).read() == LINE0
+
+
+def test_tail_follower_idle_timeout_bounds_the_stream(tmp_path):
+    src = tmp_path / "shard"
+    src.write_text(LINE)
+    f = TailFollower(str(src), _data_cfg(tmp_path))
+    t0 = time.monotonic()
+    segs = list(f.segments())  # must END via stream_idle_s, not hang
+    assert len(segs) == 1
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_tail_follower_convert_on_arrival(tmp_path):
+    src = tmp_path / "shard"
+    src.write_text(LINE + LINE0)
+    cfg = _data_cfg(tmp_path, **{"data.cache": "on",
+                                 "data.cache_dir": str(tmp_path / "cc")})
+    f = TailFollower(str(src), cfg)
+    seg = f.poll()[0]
+    # the sealed segment rides the packed device-rate path: its .xfc
+    # sidecar exists and is stamped into the segment (and the record)
+    assert seg.cache and os.path.exists(seg.cache)
+
+
+def test_stream_dir_default_is_next_to_the_shards(tmp_path):
+    cfg = _data_cfg(tmp_path, **{"data.stream_dir": ""})
+    d = stream_dir_for(str(tmp_path / "sub" / "train"), cfg)
+    assert d == str(tmp_path / "sub" / ".xfstream")
+
+
+# -------------------------------------------------- publication round-trip
+
+
+def test_publication_sidecar_roundtrip(tmp_path):
+    pub = {"step": 10, "seq": 1, "trace": "ab" * 8, "span": "cd" * 8,
+           "ingest_ts": 100.0, "consumed_ts": 101.0, "published_ts": 103.0}
+    step_dir = tmp_path / "step_10"
+    step_dir.mkdir()
+    (step_dir / "publication.json").write_text(json.dumps(pub))
+    assert ckpt.read_publication(str(tmp_path), 10) == pub
+    # absence is the NORMAL case: silent None
+    assert ckpt.read_publication(str(tmp_path), 20) is None
+    # a damaged sidecar downgrades (logged) instead of gating the reload
+    (step_dir / "publication.json").write_text("{torn")
+    assert ckpt.read_publication(str(tmp_path), 10) is None
+
+
+# ------------------------------------------------------- serve-side closure
+
+
+def test_generation_freshness_with_fake_clock():
+    from xflow_tpu.serve.runner import Generation
+
+    gen = Generation(tables={}, step=10, gen=1,
+                     publication={"ingest_ts": 100.0})
+    assert gen.freshness_s(now=105.5) == pytest.approx(5.5)
+    assert gen.freshness_s(now=99.0) == 0.0  # clock skew clamps, never <0
+    # no publication (or a malformed one) = NOT MEASURABLE, never fake 0
+    assert Generation(tables={}, step=1, gen=0).freshness_s(now=1.0) is None
+    bad = Generation(tables={}, step=1, gen=0,
+                     publication={"ingest_ts": float("nan")})
+    assert bad.freshness_s(now=1.0) is None
+
+
+def test_serve_window_freshness_key_optional(tmp_path):
+    from xflow_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics(str(tmp_path / "s.jsonl"), every_s=0.05, batch_size=4)
+    m.observe_batch(2, 4, [0.001], 0.002, [0.003])
+    rec = m.maybe_flush(1, 10, force=True, freshness_s=2.5)
+    assert rec["data_freshness_s"] == 2.5
+    m.observe_batch(2, 4, [0.001], 0.002, [0.003])
+    rec2 = m.maybe_flush(1, 10, force=True, freshness_s=None)
+    # None (unpublished generation) leaves the record byte-identical to
+    # a pre-freshness build — absent, not 0
+    assert "data_freshness_s" not in rec2
+    m.observe_batch(2, 4, [0.001], 0.002, [0.003])
+    rec3 = m.maybe_flush(1, 10, force=True, freshness_s=-0.2)
+    assert rec3["data_freshness_s"] == 0.0  # clock skew clamps
+    m.close()
+
+
+# -------------------------------------------------------- report gates
+
+
+def _tools():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    import freshness_report
+    import metrics_report
+    import perf_ledger
+
+    return metrics_report, freshness_report, perf_ledger
+
+
+def _stamp(kind, ts=1.0, **kw):
+    rec = {"ts": ts, "rank": 0, "run_id": "r1", "kind": kind}
+    rec.update(kw)
+    return rec
+
+
+def _ingest(seq, ts=1.0, **kw):
+    rec = _stamp("ingest", ts=ts, trace=f"t{seq:015d}", seq=seq,
+                 source="s-00000", offset=0, rows=4, bytes=100, cache="",
+                 ingest_ts=ts)
+    rec.update(kw)
+    return rec
+
+
+def _publish(seq, step, ts=2.0, **kw):
+    rec = _stamp("publish", ts=ts, step=step, seq=seq,
+                 trace=f"t{seq:015d}", ingest_ts=ts - 1.0, published_ts=ts)
+    rec.update(kw)
+    return rec
+
+
+def _write(tmp_path, name, recs):
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(p)
+
+
+def test_check_gates_ingest_stream(tmp_path):
+    mr, _, _ = _tools()
+    ok = _write(tmp_path, "ok.jsonl", [_ingest(0), _ingest(1, ts=2.0)])
+    assert mr.main([ok, "--check"]) == 0
+    partial = _ingest(0)
+    del partial["offset"]
+    assert mr.main([_write(tmp_path, "p.jsonl", [partial]), "--check"]) == 2
+    # the follower's segment numbering only moves forward
+    backwards = [_ingest(1), _ingest(1, ts=2.0)]
+    assert mr.main([_write(tmp_path, "b.jsonl", backwards), "--check"]) == 2
+    assert mr.main(
+        [_write(tmp_path, "n.jsonl", [_ingest(0, rows=-1)]), "--check"]
+    ) == 2
+
+
+def test_check_gates_publish_stream(tmp_path):
+    mr, _, _ = _tools()
+    ok = _write(tmp_path, "ok.jsonl",
+                [_publish(1, 10), _publish(2, 20, ts=3.0)])
+    assert mr.main([ok, "--check"]) == 0
+    # a publication cannot predate the data it trained on
+    early = _publish(1, 10)
+    early["published_ts"] = early["ingest_ts"] - 5.0
+    assert mr.main([_write(tmp_path, "e.jsonl", [early]), "--check"]) == 2
+    # publish seq repeats = two publishers in one stream
+    rep = [_publish(1, 10), _publish(1, 20, ts=3.0)]
+    assert mr.main([_write(tmp_path, "r.jsonl", rep), "--check"]) == 2
+    partial = _publish(1, 10)
+    del partial["trace"]
+    assert mr.main([_write(tmp_path, "t.jsonl", [partial]), "--check"]) == 2
+
+
+def test_check_gates_serve_freshness_key(tmp_path):
+    from xflow_tpu.serve.metrics import SERVE_WINDOW_KEYS
+
+    mr, _, _ = _tools()
+
+    def window(**kw):
+        rec = _stamp("serve", **{k: 1 for k in SERVE_WINDOW_KEYS})
+        rec.update(generation=1, step=4)
+        rec.update(kw)
+        return rec
+
+    # with the key, without the key: both legal (doubly optional —
+    # absence means "not measurable", the OPTIONAL_SERVE_KEYS contract)
+    ok = _write(tmp_path, "ok.jsonl",
+                [window(), window(ts=2.0, data_freshness_s=3.25)])
+    assert mr.main([ok, "--check"]) == 0
+    bad = _write(tmp_path, "bad.jsonl", [window(data_freshness_s=-1.0)])
+    assert mr.main([bad, "--check"]) == 2
+
+
+def test_health_names_the_stalest_replica(tmp_path, capsys):
+    from xflow_tpu.serve.metrics import SERVE_WINDOW_KEYS
+
+    mr, _, _ = _tools()
+
+    def window(rank, fresh, ts):
+        rec = _stamp("serve", ts=ts, **{k: 1 for k in SERVE_WINDOW_KEYS})
+        rec.update(rank=rank, generation=1, step=4,
+                   data_freshness_s=fresh)
+        return rec
+
+    path = _write(tmp_path, "fleet.jsonl", [
+        _publish(1, 10),
+        window(0, 2.5, ts=3.0),
+        window(1, 9.75, ts=3.0),
+    ])
+    assert mr.main([path, "--health"]) == 0
+    out = capsys.readouterr().out
+    assert "freshness" in out
+    assert "publications: 1" in out
+    assert "stalest replica: rank 1" in out and "9.75" in out
+
+
+# --------------------------------------------------- freshness_report Δ
+
+
+def _loop_records(trace="ab" * 8):
+    pub_span, reload_span = "p" * 16, "r" * 16
+    return [
+        _stamp("ingest", ts=100.0, trace=trace, seq=0, source="s-00000",
+               offset=0, rows=4, bytes=100, cache="", ingest_ts=100.0),
+        _stamp("publish", ts=103.0, step=10, seq=1, trace=trace,
+               ingest_ts=100.0, published_ts=103.0),
+        _stamp("span", ts=103.1, trace=trace, span=pub_span, name="publish",
+               t0=103.0, dur_ms=50.0, step=10, seq=1),
+        _stamp("span", ts=104.5, trace=trace, span=reload_span,
+               parent=pub_span, name="reload", t0=104.0, dur_ms=500.0,
+               step=10, generation=2),
+        _stamp("span", ts=105.0, trace=trace, span="f" * 16,
+               parent=reload_span, name="serve_first", t0=105.0, dur_ms=0.0,
+               step=10, generation=2),
+    ]
+
+
+def test_freshness_report_assembles_and_decomposes(tmp_path, capsys):
+    _, fr, _ = _tools()
+    path = _write(tmp_path, "run.jsonl", _loop_records())
+    out = tmp_path / "BENCH_FRESH.json"
+    rc = fr.main([path, "--bench-json", str(out), "--round", "18",
+                  "--max-delta-s", "10"])
+    assert rc == 0
+    rec = json.load(open(out))
+    assert rec["metric"] == "fresh_delta_s" and rec["round"] == 18
+    assert rec["value"] == pytest.approx(5.0)  # serve_first - ingest_ts
+    assert rec["fresh_ingest_publish_s"] == pytest.approx(3.0)
+    assert rec["fresh_publish_swap_s"] == pytest.approx(1.5)  # reload END
+    assert rec["fresh_swap_serve_s"] == pytest.approx(0.5)
+    assert rec["traces"] == 1 and rec["publications"] == 1
+    assert "closed" in capsys.readouterr().out
+
+
+def test_freshness_report_gates_open_loop_and_threshold(tmp_path):
+    _, fr, _ = _tools()
+    # no serve_first anywhere: the loop never closed — gate fails
+    open_recs = _loop_records()[:-1]
+    p1 = _write(tmp_path, "open.jsonl", open_recs)
+    assert fr.main([p1, "--max-delta-s", "10"]) == 3
+    assert fr.main([p1]) == 0  # report-only mode still prints
+    # closed but too stale for the threshold
+    p2 = _write(tmp_path, "slow.jsonl", _loop_records())
+    assert fr.main([p2, "--max-delta-s", "1"]) == 3
+
+
+def test_perf_ledger_fresh_series_gates_downward(tmp_path):
+    _, _, pl = _tools()
+    assert pl._lower_is_better("fresh_delta_s", "s")
+    assert pl._lower_is_better("fresh_publish_swap_s", "s")
+    rec = {"metric": "fresh_delta_s", "value": 2.0, "unit": "s",
+           "round": 2, "fresh_ingest_publish_s": 1.5, "publications": 3}
+    (tmp_path / "BENCH_FRESH.json").write_text(json.dumps(rec))
+    entries = pl.collect(str(tmp_path), [])
+    by_metric = {e["metric"]: e for e in entries}
+    assert by_metric["fresh_delta_s"]["series"] == "fresh"
+    assert by_metric["fresh_ingest_publish_s"]["value"] == 1.5
+    # staleness REGRESSING upward across rounds exits the gate
+    older = dict(rec, value=0.5, round=1)
+    problems = pl.check_regressions(
+        pl.normalize_fresh("BENCH_FRESH_r1.json", older)
+        + pl.normalize_fresh("BENCH_FRESH_r2.json", rec),
+        tol=0.2,
+    )
+    assert any("fresh_delta_s" in p for p in problems)
+
+
+# ------------------------------------------------------ eval window decay
+
+
+def test_bucket_auc_decay():
+    auc = BucketAUC(pos=np.array([4.0, 0.0, 2.0]),
+                    neg=np.array([1.0, 3.0, 0.0]))
+    dec = auc.decay(0.5)
+    assert np.allclose(dec.pos, [2.0, 0.0, 1.0])
+    assert np.allclose(dec.neg, [0.5, 1.5, 0.0])
+    # the un-decayed histograms are untouched (decay returns a copy)
+    assert np.allclose(auc.pos, [4.0, 0.0, 2.0])
+
+
+# ---------------------------------------------- zero-overhead-when-off pin
+
+
+def test_stream_off_is_byte_identical(tmp_path, monkeypatch):
+    """data.stream=off + publish_every=0 (the defaults): no ingest or
+    publish record, no linked span, no publication sidecar — the exact
+    pre-freshness streams and checkpoint layout (PR 9 discipline)."""
+    monkeypatch.chdir(tmp_path)
+    generate_shards(str(tmp_path / "train"), 1, 256, num_fields=6,
+                    ids_per_field=40, seed=0, noise=0.3)
+    cfg = override(Config(), **{
+        "data.train_path": str(tmp_path / "train"),
+        "data.log2_slots": 12,
+        "data.batch_size": 64,
+        "data.max_nnz": 8,
+        "model.num_fields": 6,
+        "train.epochs": 1,
+        "train.pred_dump": False,
+        "train.checkpoint_dir": str(tmp_path / "ck"),
+        "train.metrics_path": str(tmp_path / "m.jsonl"),
+    })
+    t = Trainer(cfg)
+    res = t.fit()
+    assert res.steps == 4
+    recs = [json.loads(line) for line in open(tmp_path / "m.jsonl")]
+    kinds = {r.get("kind") for r in recs}
+    assert "ingest" not in kinds and "publish" not in kinds
+    names = {r.get("name") for r in recs if r.get("kind") == "span"}
+    assert not names & {"publish", "serve_first", "reload", "serve_load"}
+    step = ckpt.latest_step(str(tmp_path / "ck"))
+    assert step is not None
+    assert ckpt.read_publication(str(tmp_path / "ck"), step) is None
+    assert not list((tmp_path / "ck").rglob("publication.json"))
+
+
+def test_fit_rejects_unknown_stream_mode(tmp_path):
+    generate_shards(str(tmp_path / "train"), 1, 64, num_fields=6,
+                    ids_per_field=40, seed=0, noise=0.3)
+    cfg = override(Config(), **{
+        "data.train_path": str(tmp_path / "train"),
+        "data.stream": "firehose",
+        "data.log2_slots": 12,
+        "data.batch_size": 64,
+        "data.max_nnz": 8,
+        "model.num_fields": 6,
+    })
+    with pytest.raises(ValueError, match="data.stream"):
+        Trainer(cfg).fit()
+
+
+# ----------------------------------------------------- streaming mini-run
+
+
+def test_fit_tail_publishes_with_sidecars(tmp_path, monkeypatch):
+    """A bounded tail run over a pre-seeded shard: segments seal, the
+    publish cadence commits checkpoints WITH publication sidecars, and
+    the metrics stream carries the full breadcrumb trail (ingest +
+    publish records, publish spans) — check-green."""
+    monkeypatch.chdir(tmp_path)
+    generate_shards(str(tmp_path / "stream"), 1, 256, num_fields=6,
+                    ids_per_field=40, seed=0, noise=0.3)
+    cfg = override(Config(), **{
+        "data.train_path": str(tmp_path / "stream"),
+        "data.log2_slots": 12,
+        "data.batch_size": 64,
+        "data.max_nnz": 8,
+        "data.stream": "tail",
+        "data.stream_poll_s": 0.02,
+        "data.stream_idle_s": 0.5,
+        "data.stream_dir": str(tmp_path / "spool"),
+        "data.cache": "off",
+        "model.num_fields": 6,
+        "train.publish_every": 2,
+        "train.pred_dump": False,
+        "train.checkpoint_dir": str(tmp_path / "ck"),
+        "train.metrics_path": str(tmp_path / "m.jsonl"),
+    })
+    res = Trainer(cfg).fit()
+    assert res.steps == 4  # 256 rows / 64
+    recs = [json.loads(line) for line in open(tmp_path / "m.jsonl")]
+    ingests = [r for r in recs if r.get("kind") == "ingest"]
+    pubs = [r for r in recs if r.get("kind") == "publish"]
+    assert len(ingests) >= 1 and len(pubs) >= 1
+    spans = [r for r in recs if r.get("kind") == "span"
+             and r.get("name") == "publish"]
+    # every publish record has its linked span, carrying the SAME
+    # ingest trace id the segment sealed with
+    assert {s["trace"] for s in spans} == {p["trace"] for p in pubs}
+    assert {p["trace"] for p in pubs} <= {i["trace"] for i in ingests}
+    # the newest committed step carries a complete publication sidecar
+    step = ckpt.latest_step(str(tmp_path / "ck"))
+    pub = ckpt.read_publication(str(tmp_path / "ck"), step)
+    assert pub is not None and pub["step"] == step
+    assert pub["published_ts"] >= pub["consumed_ts"] >= pub["ingest_ts"] > 0
+    mr, _, _ = _tools()
+    assert mr.main([str(tmp_path / "m.jsonl"), "--check"]) == 0
+
+
+# ----------------------------------------------------------- CI live drill
+
+
+@pytest.mark.slow
+def test_smoke_fresh_script(tmp_path):
+    """The live freshness drill end to end (tools/smoke_fresh.sh):
+    tail-mode trainer following a growing shard -> in-run publications
+    -> 2-replica fleet hot-swapping them under closed-loop load with
+    rows appended mid-bench -> zero failed requests, fleet freshness
+    surfaced on /healthz, freshness_report Δ gate + BENCH_FRESH.json,
+    metrics_report --check green. Slow-marked like the other live
+    drills: the stream's idle timeout alone is 25s of wall."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        ["bash", os.path.join(REPO_ROOT, "tools", "smoke_fresh.sh"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=570, env=env,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "smoke_fresh: OK" in r.stdout
+    assert "fleet freshness OK" in r.stdout
+    bench = json.load(open(tmp_path / "BENCH_FRESH.json"))
+    assert bench["metric"] == "fresh_delta_s" and bench["value"] > 0
+    assert bench["traces"] >= 1

@@ -1,0 +1,360 @@
+#!/usr/bin/env python3
+"""Freshness Δ report: one trace id from ingested row to served
+prediction (docs/SERVING.md "Freshness", docs/OBSERVABILITY.md
+"Freshness tracing").
+
+The streaming loop leaves four breadcrumbs in ordinary metrics JSONL,
+all carrying the SAME ingest trace id:
+
+    kind="ingest"              the tail follower sealed a segment
+    kind="publish"             the trainer published a mid-run checkpoint
+    span name="publish"        the same publication as a linked span
+    span name="reload"/        the serve replica swapped the published
+         "serve_load"          generation in (one per replica)
+    span name="serve_first"    the first prediction served off it
+
+This tool reassembles the loop across process boundaries — the trainer
+and every replica write SEPARATE files; the trace id is the join key —
+and decomposes the end-to-end delta:
+
+    fresh_delta_s           serve_first.t0 - ingest_ts   (the headline)
+    fresh_ingest_publish_s  published_ts  - ingest_ts    (train + save)
+    fresh_publish_swap_s    reload end    - published_ts (detect + load)
+    fresh_swap_serve_s      serve_first   - reload end   (first traffic)
+
+Fleet semantics: per trace, each leg takes the WORST replica (max) —
+freshness is an SLO, and the SLO is only as good as the stalest
+replica. The headline is the max over fully-closed traces (a trace is
+closed once at least one replica served off it).
+
+    python tools/freshness_report.py RUNDIR [RUNDIR...]
+    python tools/freshness_report.py RUNDIR --checkpoint-dir CKPT \
+        --bench-json BENCH_FRESH.json --round 18 --max-delta-s 60
+
+`--checkpoint-dir` folds in the publication.json sidecars'
+`consumed_ts` (checkpoint.read_publication), splitting the first leg
+into ingest->consume (queue/poll latency) and consume->publish
+(train + save). `--bench-json` writes the perf-ledger record
+(series "fresh", every leg gated DOWNWARD — tools/perf_ledger.py).
+`--max-delta-s` gates: exit 3 when the headline exceeds it, or when no
+trace closed at all (a loop that never closes is the worst staleness).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xflow_tpu.jsonl import read_jsonl_counted  # noqa: E402
+
+RELOAD_SPAN_NAMES = ("reload", "serve_load")
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and math.isfinite(x)
+
+
+def expand_paths(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "*.jsonl")))
+            if not found:
+                raise FileNotFoundError(
+                    f"{p!r}: directory holds no *.jsonl files"
+                )
+            out.extend(found)
+        elif not os.path.exists(p):
+            raise FileNotFoundError(f"{p!r}: no such file")
+        else:
+            out.append(p)
+    return out
+
+
+def load_records(files: list[str]) -> list[dict]:
+    recs: list[dict] = []
+    for path in files:
+        rows, _bad = read_jsonl_counted(path)
+        recs.extend(r for r in rows if isinstance(r, dict))
+    return recs
+
+
+def assemble(records: list[dict], ckpt_dir: str = "",
+             fmt: str = "npz") -> dict:
+    """{trace: {ingest, publish, publish_span, reloads, firsts,
+    sidecar}} — the cross-boundary join, keyed by the ingest trace id.
+    Only traces a publication carried matter here: an ingest segment
+    that never reached a publication is open by definition and reported
+    in the totals, not the table."""
+    ingests: dict = {}
+    publishes: dict = {}
+    publish_spans: dict = {}
+    reloads: dict = {}
+    firsts: dict = {}
+    n_segments = 0
+    for r in records:
+        kind = r.get("kind")
+        trace = r.get("trace")
+        if not isinstance(trace, str) or not trace:
+            continue
+        if kind == "ingest":
+            n_segments += 1
+            ingests[trace] = r
+        elif kind == "publish":
+            publishes[trace] = r
+        elif kind == "span":
+            name = r.get("name")
+            if name == "publish":
+                publish_spans[trace] = r
+            elif name in RELOAD_SPAN_NAMES:
+                reloads.setdefault(trace, []).append(r)
+            elif name == "serve_first":
+                firsts.setdefault(trace, []).append(r)
+    out: dict = {}
+    for trace, pub in sorted(publishes.items(), key=lambda kv: (
+            kv[1].get("seq", 0), kv[0])):
+        entry = {
+            "ingest": ingests.get(trace),
+            "publish": pub,
+            "publish_span": publish_spans.get(trace),
+            "reloads": reloads.get(trace, []),
+            "firsts": firsts.get(trace, []),
+            "sidecar": None,
+        }
+        if ckpt_dir and _finite(pub.get("step")):
+            from xflow_tpu.train import checkpoint as ckpt
+
+            entry["sidecar"] = ckpt.read_publication(
+                ckpt_dir, int(pub["step"]), fmt=fmt
+            )
+        out[trace] = entry
+    out["_n_segments"] = n_segments
+    return out
+
+
+def _span_end(span: dict):
+    if not (_finite(span.get("t0")) and _finite(span.get("dur_ms"))):
+        return None
+    return span["t0"] + span["dur_ms"] / 1e3
+
+
+def decompose(entry: dict):
+    """One publication's Δ legs, worst replica per leg; None when the
+    loop has not closed (no replica served off this trace yet)."""
+    pub = entry["publish"]
+    if not (_finite(pub.get("ingest_ts")) and _finite(pub.get("published_ts"))):
+        return None
+    ingest_ts, published_ts = pub["ingest_ts"], pub["published_ts"]
+    row = {
+        "trace": pub["trace"],
+        "step": pub.get("step"),
+        "seq": pub.get("seq"),
+        "ingest_ts": ingest_ts,
+        "published_ts": published_ts,
+        "fresh_ingest_publish_s": max(published_ts - ingest_ts, 0.0),
+        "replicas": 0,
+        "closed": False,
+    }
+    side = entry.get("sidecar")
+    if isinstance(side, dict) and _finite(side.get("consumed_ts")):
+        # the sidecar splits the first leg: poll/queue vs train+save
+        row["fresh_ingest_consume_s"] = max(
+            side["consumed_ts"] - ingest_ts, 0.0
+        )
+        row["fresh_consume_publish_s"] = max(
+            published_ts - side["consumed_ts"], 0.0
+        )
+    # per replica: the swap that installed this publication, then the
+    # first prediction served off it — join reload -> serve_first by
+    # the serve_first's parent (the reload's span id) falling back to
+    # rank stamps when the parent link is absent
+    swaps = []
+    for rel in entry["reloads"]:
+        end = _span_end(rel)
+        if end is None:
+            continue
+        first_t0 = None
+        for sf in entry["firsts"]:
+            if not _finite(sf.get("t0")):
+                continue
+            linked = sf.get("parent") == rel.get("span") or (
+                "parent" not in sf and sf.get("rank") == rel.get("rank")
+            )
+            if linked and (first_t0 is None or sf["t0"] < first_t0):
+                first_t0 = sf["t0"]
+        swaps.append((end, first_t0))
+    if swaps:
+        row["replicas"] = len(swaps)
+        row["fresh_publish_swap_s"] = max(
+            max(end - published_ts, 0.0) for end, _ in swaps
+        )
+        closed = [(end, ft) for end, ft in swaps if ft is not None]
+        if closed:
+            row["closed"] = True
+            row["fresh_swap_serve_s"] = max(
+                max(ft - end, 0.0) for end, ft in closed
+            )
+            row["fresh_delta_s"] = max(
+                max(ft - row["ingest_ts"], 0.0) for _end, ft in closed
+            )
+    return row
+
+
+def report(traces: dict) -> dict:
+    rows = []
+    for trace, entry in traces.items():
+        if trace == "_n_segments":
+            continue
+        row = decompose(entry)
+        if row is not None:
+            rows.append(row)
+    closed = [r for r in rows if r["closed"]]
+    out = {
+        "rows": rows,
+        "segments": traces.get("_n_segments", 0),
+        "publications": len(rows),
+        "closed": len(closed),
+        "replicas": max((r["replicas"] for r in rows), default=0),
+    }
+    # the headline + legs: worst case over closed traces — the SLO view
+    for leg in ("fresh_delta_s", "fresh_ingest_publish_s",
+                "fresh_ingest_consume_s", "fresh_consume_publish_s",
+                "fresh_publish_swap_s", "fresh_swap_serve_s"):
+        vals = [r[leg] for r in closed if _finite(r.get(leg))]
+        if vals:
+            out[leg] = round(max(vals), 3)
+    return out
+
+
+def render(rep: dict) -> str:
+    fmt = lambda v: f"{v:.3f}" if _finite(v) else "-"
+    lines = [
+        "freshness report — ingested row -> served prediction",
+        f"  segments ingested: {rep['segments']}  publications: "
+        f"{rep['publications']}  closed traces: {rep['closed']}  "
+        f"replicas: {rep['replicas']}",
+    ]
+    for r in rep["rows"]:
+        state = "closed" if r["closed"] else "OPEN (no serve_first yet)"
+        lines.append(
+            f"  trace {r['trace']} (step {r['step']}, seq {r['seq']}): "
+            f"{state}"
+        )
+        lines.append(
+            f"    ingest->publish {fmt(r.get('fresh_ingest_publish_s'))}s"
+            + (
+                f" (consume split: {fmt(r.get('fresh_ingest_consume_s'))}s"
+                f" + {fmt(r.get('fresh_consume_publish_s'))}s)"
+                if "fresh_ingest_consume_s" in r else ""
+            )
+            + f"  publish->swap {fmt(r.get('fresh_publish_swap_s'))}s"
+            f"  swap->serve {fmt(r.get('fresh_swap_serve_s'))}s"
+            f"  TOTAL {fmt(r.get('fresh_delta_s'))}s"
+        )
+    if _finite(rep.get("fresh_delta_s")):
+        lines.append(
+            f"  fleet freshness delta (worst closed trace, worst "
+            f"replica): {rep['fresh_delta_s']:.3f}s"
+        )
+    else:
+        lines.append(
+            "  fleet freshness delta: unmeasurable — no trace closed "
+            "(did any replica serve a published generation?)"
+        )
+    return "\n".join(lines)
+
+
+def bench_record(rep: dict, rnd) -> dict:
+    rec = {
+        "metric": "fresh_delta_s",
+        "value": rep.get("fresh_delta_s"),
+        "unit": "s",
+        "segments": rep["segments"],
+        "publications": rep["publications"],
+        "traces": rep["closed"],
+        "replicas": rep["replicas"],
+    }
+    if rnd is not None:
+        rec["round"] = int(rnd)
+    for leg in ("fresh_ingest_publish_s", "fresh_ingest_consume_s",
+                "fresh_consume_publish_s", "fresh_publish_swap_s",
+                "fresh_swap_serve_s"):
+        if _finite(rep.get(leg)):
+            rec[leg] = rep[leg]
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="assemble + decompose the ingest->serve freshness Δ "
+        "from metrics JSONL streams"
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="metrics .jsonl files or run directories "
+                    "(trainer + every replica — the trace id joins them)")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="fold in publication.json sidecars (splits the "
+                    "ingest->publish leg at consumed_ts)")
+    ap.add_argument("--checkpoint-format", default="npz",
+                    choices=("npz", "orbax"))
+    ap.add_argument("--json", default="", metavar="OUT",
+                    help="write the full report JSON ('-' = stdout)")
+    ap.add_argument("--bench-json", default="", metavar="OUT",
+                    help="write the perf-ledger record (BENCH_FRESH.json)")
+    ap.add_argument("--round", default=None, type=int,
+                    help="round stamp for the bench record")
+    ap.add_argument("--max-delta-s", default=0.0, type=float,
+                    help="gate: exit 3 when the headline delta exceeds "
+                    "this (or no trace closed); 0 = report only")
+    args = ap.parse_args(argv)
+
+    try:
+        files = expand_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"freshness_report: {e}", file=sys.stderr)
+        return 2
+    records = load_records(files)
+    traces = assemble(records, args.checkpoint_dir, args.checkpoint_format)
+    rep = report(traces)
+    print(render(rep))
+    if args.json:
+        payload = json.dumps(rep, indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    if args.bench_json:
+        with open(args.bench_json, "w") as f:
+            f.write(json.dumps(bench_record(rep, args.round), indent=1) + "\n")
+    if args.max_delta_s > 0:
+        delta = rep.get("fresh_delta_s")
+        if not _finite(delta):
+            print(
+                "freshness_report: GATE: no closed trace — the loop "
+                "never reached a served prediction",
+                file=sys.stderr,
+            )
+            return 3
+        if delta > args.max_delta_s:
+            print(
+                f"freshness_report: GATE: fresh_delta_s {delta:.3f}s > "
+                f"--max-delta-s {args.max_delta_s:.3f}s",
+                file=sys.stderr,
+            )
+            return 3
+        print(
+            f"freshness_report: gate ok ({delta:.3f}s <= "
+            f"{args.max_delta_s:.3f}s)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

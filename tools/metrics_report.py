@@ -100,11 +100,15 @@ SERVE_KEYS = (
     "shed_requests",
     "generation",
     "step",
+    "data_freshness_s",
 )
 # serve window keys added AFTER runs were already archived: absence
 # means a pre-upgrade writer (or a mid-upgrade fleet mixing binaries),
-# not a schema violation — present they ride the all-or-none gate
-OPTIONAL_SERVE_KEYS = ("shed_requests",)
+# not a schema violation — present they ride the all-or-none gate.
+# data_freshness_s is doubly optional: it only exists while the served
+# generation carries a publication sidecar (train.publish_every), so a
+# window without it means "not measurable", never a violation
+OPTIONAL_SERVE_KEYS = ("shed_requests", "data_freshness_s")
 # the key set every kind="autotune" decision record carries (serve
 # /autotune.py controller applied by server.ServeApp._autotune —
 # docs/OBSERVABILITY.md "SLO autotuning"); --check enforces
@@ -170,6 +174,31 @@ PIPELINE_SUM_SLACK = 1.25
 # root has none), everything else is the assembly contract
 # tools/request_trace.py depends on
 SPAN_KEYS = ("trace", "span", "name", "t0", "dur_ms")
+# the key set every kind="ingest" record carries (data/pipeline
+# .TailFollower.segments — docs/OBSERVABILITY.md "Freshness tracing"):
+# one record per sealed streaming segment, `trace` is the ingest trace
+# id the publish/reload/serve_first spans later link to; --check
+# enforces all-or-none, non-negative finite rows/bytes/offset, and a
+# strictly increasing seq per stream (the follower numbers segments
+# 0, 1, 2, ... — a repeat or regression means two followers wrote one
+# stream)
+INGEST_KEYS = (
+    "trace",
+    "seq",
+    "source",
+    "offset",
+    "rows",
+    "bytes",
+    "cache",
+    "ingest_ts",
+)
+# the key set every kind="publish" record carries (train/trainer
+# ._publish_checkpoint): one per in-run committed publication
+# (train.publish_every), stamped with the newest contributing ingest
+# trace; --check enforces all-or-none, monotone seq, and
+# published_ts >= ingest_ts (a publication cannot predate the data it
+# trained on). `step` rides the generic step-monotonicity gate.
+PUBLISH_KEYS = ("step", "seq", "trace", "ingest_ts", "published_ts")
 # the key set every kind="sync" record carries (parallel/multislice
 # .SliceSyncer.sync — docs/OBSERVABILITY.md "Multi-slice sync
 # records"); --check enforces all-or-none, a strictly increasing round
@@ -615,6 +644,9 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         prev_live = None  # sync streams: membership ledger
         last_at_ts = float("-inf")  # autotune streams: decision trail
         # stays time-ordered (one controller per stream)
+        last_ingest_seq = -1  # ingest streams: the follower's segment
+        # counter only moves forward within a stream
+        last_pub_seq = -1  # publish streams: publication counter ditto
         for i, rec in enumerate(records, 1):
             for key in STAMP_KEYS:
                 if key not in rec:
@@ -761,6 +793,75 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                             f"({last_model_gen} -> {mg}) at record {i}"
                         )
                     last_model_gen = max(last_model_gen, mg)
+                fresh = rec.get("data_freshness_s")
+                if fresh is not None and (not _finite(fresh) or fresh < 0):
+                    problems.append(
+                        f"{tag}: record {i} has non-numeric or negative "
+                        "data_freshness_s"
+                    )
+            if kind == "ingest":
+                in_missing = [k for k in INGEST_KEYS if k not in rec]
+                if in_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks ingest keys {in_missing}"
+                    )
+                    continue
+                for key in ("offset", "rows", "bytes"):
+                    if not _finite(rec[key]) or rec[key] < 0:
+                        problems.append(
+                            f"{tag}: record {i} has non-numeric or "
+                            f"negative {key}"
+                        )
+                if not isinstance(rec["trace"], str) or not rec["trace"]:
+                    problems.append(
+                        f"{tag}: record {i} has an empty ingest trace id"
+                    )
+                if not _finite(rec["ingest_ts"]):
+                    problems.append(
+                        f"{tag}: record {i} has non-numeric ingest_ts"
+                    )
+                sq = rec["seq"]
+                if not _finite(sq) or sq <= last_ingest_seq:
+                    problems.append(
+                        f"{tag}: ingest seq {last_ingest_seq} -> {sq} at "
+                        f"record {i} — segment numbering must strictly "
+                        "increase (two followers wrote one stream?)"
+                    )
+                if _finite(sq):
+                    last_ingest_seq = max(last_ingest_seq, int(sq))
+            if kind == "publish":
+                pb_missing = [k for k in PUBLISH_KEYS if k not in rec]
+                if pb_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks publish keys {pb_missing}"
+                    )
+                    continue
+                if not isinstance(rec["trace"], str) or not rec["trace"]:
+                    problems.append(
+                        f"{tag}: record {i} has an empty publication "
+                        "trace id"
+                    )
+                if not (_finite(rec["ingest_ts"]) and _finite(rec["published_ts"])):
+                    problems.append(
+                        f"{tag}: record {i} has non-numeric "
+                        "ingest_ts/published_ts"
+                    )
+                elif rec["published_ts"] < rec["ingest_ts"]:
+                    problems.append(
+                        f"{tag}: record {i} published_ts "
+                        f"{rec['published_ts']} < ingest_ts "
+                        f"{rec['ingest_ts']} — a publication cannot "
+                        "predate the data it trained on"
+                    )
+                sq = rec["seq"]
+                if not _finite(sq) or sq <= last_pub_seq:
+                    problems.append(
+                        f"{tag}: publish seq {last_pub_seq} -> {sq} at "
+                        f"record {i} — publication numbering must "
+                        "strictly increase"
+                    )
+                if _finite(sq):
+                    last_pub_seq = max(last_pub_seq, int(sq))
             if kind == "autotune":
                 a_present = [k for k in AUTOTUNE_KEYS if k in rec]
                 a_missing = [k for k in AUTOTUNE_KEYS if k not in rec]
@@ -1228,7 +1329,77 @@ def render_health(streams: dict) -> str:
     sync_lines = render_sync_staleness(streams, newest)
     if sync_lines:
         lines.extend(sync_lines)
+    fresh_lines = render_freshness(streams, newest)
+    if fresh_lines:
+        lines.extend(fresh_lines)
     return "\n".join(lines)
+
+
+def render_freshness(streams: dict, run_id: str) -> list[str]:
+    """The data-freshness section for the --health view (docs/SERVING.md
+    "Freshness"): publication cadence from the trainer's kind="publish"
+    stream, then each serving replica's NEWEST data_freshness_s window
+    gauge, and the stalest replica named — the first question a
+    streaming run answers: how old is the data behind the predictions,
+    and who is serving the oldest model? Empty when the run carries no
+    publish records and no freshness-stamped serve windows
+    (train.publish_every off, or a non-streaming run)."""
+    pubs = 0
+    last_pub = None  # (ts, step)
+    for (rid, _rank, kind, _gen), recs in sorted(streams.items(), key=str):
+        if kind != "publish" or rid != run_id:
+            continue
+        pubs += len(recs)
+        for r in recs:
+            if _finite(r.get("published_ts")):
+                cand = (r["published_ts"], r.get("step"))
+                if last_pub is None or cand > last_pub:
+                    last_pub = cand
+    # newest freshness-stamped window per serve stream; fold replicas
+    # by rank (restart generations of one rank collapse, newest wins)
+    by_rank: dict = {}  # rank -> (ts, freshness, model_gen)
+    for (rid, rank, kind, _gen), recs in sorted(streams.items(), key=str):
+        if kind != "serve" or rid != run_id:
+            continue
+        for r in recs:
+            f = r.get("data_freshness_s")
+            if not _finite(f):
+                continue
+            cand = (r.get("ts", 0.0), f, r.get("generation"))
+            if rank not in by_rank or cand[0] > by_rank[rank][0]:
+                by_rank[rank] = cand
+    if not pubs and not by_rank:
+        return []
+    out = ["  freshness (kind=publish + serve data_freshness_s):"]
+    if pubs:
+        tail = ""
+        if last_pub is not None:
+            tail = f"  last at step {last_pub[1]}"
+        out.append(f"    publications: {pubs}{tail}")
+    else:
+        out.append(
+            "    publications: none in this run's streams "
+            "(serving a checkpoint published elsewhere)"
+        )
+    stalest = None  # (freshness, rank)
+    for rank, (_ts, f, mgen) in sorted(by_rank.items(), key=str):
+        out.append(
+            f"    replica rank {rank}: data_freshness_s {f:.3f} "
+            f"(model generation {mgen})"
+        )
+        if stalest is None or f > stalest[0]:
+            stalest = (f, rank)
+    if stalest is not None:
+        out.append(
+            f"    stalest replica: rank {stalest[1]} "
+            f"({stalest[0]:.3f}s behind the newest ingested row)"
+        )
+    elif pubs:
+        out.append(
+            "    no serving replica reported data_freshness_s "
+            "(fleet not running, or windows predate the publication)"
+        )
+    return out
 
 
 def render_sync_staleness(streams: dict, run_id: str) -> list[str]:

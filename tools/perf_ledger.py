@@ -82,9 +82,13 @@ def _lower_is_better(metric, unit) -> bool:
     the regression direction flip relative to throughput. `_ratio`
     metrics (the pipeline host-gap ratio) are gap-shaped: a round that
     climbs back toward text-path ratios is the regression the
-    packed-shard-cache gate exists to catch."""
+    packed-shard-cache gate exists to catch. `fresh_*` metrics
+    (BENCH_FRESH.json, tools/freshness_report.py) are delay-shaped —
+    seconds from ingested row to served prediction — and gate downward
+    too: a round where data gets STALER is the regression."""
     return (
         str(metric).endswith(("_ms", "_ns", "_ns_per_element", "_ratio"))
+        or str(metric).startswith("fresh_")
         or str(unit).startswith(("ms", "ns"))
     )
 
@@ -320,6 +324,47 @@ def normalize_serve(path: str, data) -> list[dict]:
     return out
 
 
+def normalize_fresh(path: str, data) -> list[dict]:
+    """One BENCH_FRESH*.json (tools/freshness_report.py --bench-json,
+    docs/SERVING.md "Freshness") -> ledger entries: the headline
+    end-to-end `fresh_delta_s` (ingested row -> first served
+    prediction, fleet max) plus one group per Δ-decomposition leg
+    (`fresh_<leg>_s`). Every group is delay-shaped: `_lower_is_better`
+    keys on the `fresh_` prefix, so a round where data gets staler
+    exits 3 under --regress."""
+    if not isinstance(data, dict) or "metric" not in data:
+        return []
+    rnd = _round_of(path)
+    if rnd is None and _finite(data.get("round")):
+        rnd = int(data["round"])
+    entry = {
+        "series": "fresh",
+        "round": rnd,
+        "path": os.path.basename(path),
+        "metric": data["metric"],
+        "value": data.get("value"),
+        "unit": data.get("unit", "s"),
+        "headline": True,
+    }
+    for key in ("publications", "replicas", "traces", "segments"):
+        if _finite(data.get(key)):
+            entry[key] = data[key]
+    out = [entry]
+    for key, v in data.items():
+        if key == data["metric"]:
+            continue
+        if key.startswith("fresh_") and key.endswith("_s") and _finite(v):
+            out.append({
+                "series": "fresh",
+                "round": rnd,
+                "path": os.path.basename(path),
+                "metric": key,
+                "value": v,
+                "unit": "s",
+            })
+    return out
+
+
 def collect(root: str, extra: list[str]) -> list[dict]:
     """Every ledger entry under `root` (+ explicit extra files), sorted
     by (series, metric, round)."""
@@ -348,6 +393,10 @@ def collect(root: str, extra: list[str]) -> list[dict]:
             # the sparse-primitive lab matrix (bench_lab --suite core):
             # per-cell ns/element groups, gated downward
             entries.extend(normalize_lab(path, data))
+        elif name.startswith("BENCH_FRESH"):
+            # the streaming-freshness Δ record (freshness_report): the
+            # end-to-end delta and its decomposition legs, gated downward
+            entries.extend(normalize_fresh(path, data))
         elif name.startswith(("BENCH_SERVE", "BENCH_TRACE")):
             # BENCH_TRACE.json is the serve_bench record measured with
             # request tracing on (tools/smoke_trace.sh): same serve_qps
@@ -358,7 +407,8 @@ def collect(root: str, extra: list[str]) -> list[dict]:
 
     for pattern in ("BENCH_r*.json", "BENCH_SCALE*.json", "MULTICHIP_r*.json",
                     "BENCH_SERVE*.json", "BENCH_TRACE*.json",
-                    "BENCH_LAB*.json", "BENCH_PIPELINE*.json"):
+                    "BENCH_LAB*.json", "BENCH_PIPELINE*.json",
+                    "BENCH_FRESH*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             add(path)
     for path in extra:
@@ -649,6 +699,30 @@ def render_markdown(entries: list[dict], hbm_gbps: float) -> str:
             lines.append(f"| {e['path']} | {e['metric']} | {_fmt(e['value'])} "
                          f"| {_fmt(e.get('p50_ms'))} | {_fmt(e.get('p99_ms'))} "
                          f"| {_fmt(over) + '%' if over is not None else '-'} |")
+        lines.append("")
+    fresh = groups_of([e for e in entries if e["series"] == "fresh"])
+    if fresh:
+        # the Δ decomposition read top to bottom: the headline
+        # end-to-end delta, then each leg of the stream -> train ->
+        # publish -> serve loop. Lower is fresher; the bench gate above
+        # already enforces the direction.
+        lines += ["## Freshness (`BENCH_FRESH*.json`, ingested row → "
+                  "served prediction)", "",
+                  "| metric | rounds | first | best | newest |",
+                  "|---|---|---|---|---|"]
+        for (_, metric), group in sorted(fresh.items(), key=str):
+            vals = [e for e in group if _finite(e["value"])]
+            if not vals:
+                continue
+            rounds = [e["round"] for e in vals if e["round"] is not None]
+            best = min(vals, key=lambda e: e["value"])  # s: lower = fresher
+            lines.append(
+                f"| {metric} | {_fmt(min(rounds)) if rounds else '-'}→"
+                f"{_fmt(max(rounds)) if rounds else '-'} "
+                f"| {_fmt(vals[0]['value'])} "
+                f"| {_fmt(best['value'])} (r{_fmt(best['round'])}) "
+                f"| {_fmt(vals[-1]['value'])} |"
+            )
         lines.append("")
     roof = roofline(entries, hbm_gbps)
     if roof:

@@ -1,0 +1,244 @@
+#!/usr/bin/env bash
+# Streaming-freshness gate (docs/SERVING.md "Freshness", docs/DATA.md
+# "Streaming source") — the whole stream -> train -> publish -> serve
+# loop, live, under load:
+#
+# 1. Seed a libffm shard, then start a TAIL-MODE trainer
+#    (data.stream=tail) that follows it: segments seal with ingest
+#    trace ids, and every train.publish_every steps a committed
+#    checkpoint publishes WITH its publication.json trace sidecar.
+# 2. Wait for the first publication, then start a 2-replica
+#    `xflow serve-fleet` on the SAME checkpoint dir (hot-reload poll +
+#    span sink on), behind the health-checked router.
+# 3. Drive tools/serve_bench.py closed-loop through the router while
+#    APPENDING new rows to the watched shard mid-bench — the trainer
+#    ingests them, publishes, and the replicas hot-swap the new
+#    generations under live traffic. Gate: ZERO failed requests.
+# 4. The trainer's idle timeout ends the stream; a last trickle of
+#    requests closes the final publication's serve_first span. Gate:
+#    the router /healthz carries the fleet freshness spread
+#    (freshness_min_s / freshness_max_s / stalest_replica) and every
+#    replica reports data_freshness_s.
+# 5. tools/freshness_report.py reassembles the cross-process trace
+#    (ingest -> publish -> reload -> serve_first), writes the
+#    BENCH_FRESH.json ledger record, and GATES the end-to-end delta;
+#    tools/metrics_report.py --check is green over the whole run dir
+#    (ingest/publish/freshness schema gates included).
+#
+# Standalone:    bash tools/smoke_fresh.sh [workdir]
+# From pytest:   tests/test_freshness.py::test_smoke_fresh_script
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# bench datapoint destination: the repo root ONLY standalone (the
+# per-PR record); under pytest it stays in the workdir
+BENCH_OUT="$ROOT/BENCH_FRESH.json"
+TRAIN_PID=""
+FLEET_PID=""
+cleanup() {
+    if [ -n "$TRAIN_PID" ]; then kill -9 "$TRAIN_PID" 2>/dev/null || true; fi
+    if [ -n "$FLEET_PID" ]; then kill -9 "$FLEET_PID" 2>/dev/null || true; fi
+    # replicas are children of the fleet; sweep any orphans by this
+    # run's unique workdir path
+    pkill -9 -f "run_fresh" 2>/dev/null || true
+    if [ -n "${TMP_WORK:-}" ]; then rm -rf "$TMP_WORK"; fi
+}
+trap cleanup EXIT
+if [ -z "$WORK" ]; then
+    TMP_WORK="$(mktemp -d)"
+    WORK="$TMP_WORK"
+else
+    BENCH_OUT="$WORK/BENCH_FRESH.json"
+fi
+
+export JAX_PLATFORMS=cpu
+# single CPU device (xargs trims; an empty result must UNSET the var —
+# XLA treats a whitespace-only value as a flags FILE to open and aborts)
+XLA_FLAGS="$(printf '%s\n' ${XLA_FLAGS:-} \
+    | grep -v xla_force_host_platform_device_count | xargs || true)"
+if [ -n "$XLA_FLAGS" ]; then export XLA_FLAGS; else unset XLA_FLAGS; fi
+
+MODEL_ARGS=(--model lr --log2-slots 12
+            --set model.num_fields=6 --set data.max_nnz=8)
+RUN="$WORK/run_fresh"
+mkdir -p "$RUN"
+
+# ---- 1. seed the watched shard + start the tail-mode trainer --------------
+python -m xflow_tpu gen-data "$WORK/stream" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+# the mid-bench appends (1600 rows each = 25 more steps per append at
+# batch 64, so each one crosses at least one publish_every=10 boundary)
+python -m xflow_tpu gen-data "$WORK/more1" --shards 1 --rows 1600 \
+    --fields 6 --ids-per-field 50 --seed 1 >/dev/null
+python -m xflow_tpu gen-data "$WORK/more2" --shards 1 --rows 1600 \
+    --fields 6 --ids-per-field 50 --seed 2 >/dev/null
+python -m xflow_tpu gen-data "$WORK/reqs" --shards 1 --rows 512 \
+    --fields 6 --ids-per-field 50 --seed 9 --truth-seed 0 >/dev/null
+
+python -m xflow_tpu train --train "$WORK/stream" "${MODEL_ARGS[@]}" \
+    --batch-size 64 --checkpoint-dir "$WORK/ck" \
+    --set data.stream=tail --set data.stream_poll_s=0.2 \
+    --set data.stream_idle_s=25 \
+    --set train.publish_every=10 --set train.pred_dump=false \
+    --set train.log_every=10 \
+    --set train.metrics_path="$RUN/train_metrics.jsonl" \
+    >/dev/null 2>"$WORK/train.log" &
+TRAIN_PID=$!
+
+for i in $(seq 1 240); do
+    if ls "$WORK"/ck/step_*/publication.json >/dev/null 2>&1; then break; fi
+    kill -0 "$TRAIN_PID" 2>/dev/null || {
+        echo "smoke_fresh: trainer died before the first publication"
+        cat "$WORK/train.log"; exit 1; }
+    sleep 0.5
+done
+ls "$WORK"/ck/step_*/publication.json >/dev/null 2>&1 || {
+    echo "smoke_fresh: no publication ever committed"
+    cat "$WORK/train.log"; exit 1; }
+
+# ---- 2. start the 2-replica fleet on the live checkpoint dir --------------
+# trace_sample_rate > 0 binds the span sink (the publish->reload->
+# serve_first links are operational spans, always emitted once bound;
+# the low rate just keeps per-request span volume out of the smoke)
+python -m xflow_tpu serve-fleet --checkpoint-dir "$WORK/ck" "${MODEL_ARGS[@]}" \
+    --replicas 2 --port 0 --window-ms 3 --max-batch 64 --poll-s 0.3 \
+    --reload-stagger-s 0.2 --retries 3 --deadline-ms 15000 \
+    --health-poll-s 0.2 --run-dir "$RUN" \
+    --no-mesh --set serve.metrics_every_s=1 \
+    --set serve.trace_sample_rate=0.01 \
+    >"$WORK/fleet_ready.json" 2>"$WORK/fleet.log" &
+FLEET_PID=$!
+
+for i in $(seq 1 360); do
+    [ -s "$WORK/fleet_ready.json" ] && break
+    kill -0 "$FLEET_PID" 2>/dev/null || {
+        echo "smoke_fresh: fleet died during startup"
+        cat "$WORK/fleet.log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$WORK/fleet_ready.json" ] || {
+    echo "smoke_fresh: fleet never became ready"
+    cat "$WORK/fleet.log"; exit 1; }
+PORT=$(python - "$WORK/fleet_ready.json" <<'EOF'
+import json, sys
+ready = json.load(open(sys.argv[1]))
+assert ready["fleet"] and len(ready["replicas"]) == 2, ready
+print(ready["router_port"])
+EOF
+)
+
+# ---- 3. bench through the router while the shard grows --------------------
+python tools/serve_bench.py --url "http://127.0.0.1:$PORT" \
+    --data "$WORK/reqs-00000" --duration 12 --concurrency 4 \
+    --rows-per-request 4 --retries 3 --deadline-ms 20000 \
+    >"$WORK/bench_report.json" 2>"$WORK/bench.log" &
+BENCH_PID=$!
+sleep 2
+cat "$WORK/more1-00000" >>"$WORK/stream-00000"   # new rows land mid-load
+sleep 3
+cat "$WORK/more2-00000" >>"$WORK/stream-00000"
+rc=0; wait "$BENCH_PID" || rc=$?
+[ "$rc" -eq 0 ] || {
+    echo "smoke_fresh: loadgen saw failed requests during live reloads"
+    cat "$WORK/bench_report.json" "$WORK/fleet.log"; exit 1; }
+python - "$WORK/bench_report.json" <<'EOF'
+import json, sys
+rec = json.load(open(sys.argv[1]))
+assert rec["errors"] == 0, rec
+assert rec["deadline_exceeded"] == 0, rec
+assert len(rec["steps"]) >= 2, (
+    f"appended rows never hot-reloaded mid-bench (served steps "
+    f"{rec['steps']})")
+print(f"smoke_fresh: load OK (qps {rec['value']}, served steps "
+      f"{rec['steps']}, {rec['requests']} requests, 0 failed)")
+EOF
+
+# ---- 4. stream ends; close the final trace + check the fleet surface ------
+for i in $(seq 1 480); do
+    kill -0 "$TRAIN_PID" 2>/dev/null || break
+    sleep 0.5
+done
+if kill -0 "$TRAIN_PID" 2>/dev/null; then
+    echo "smoke_fresh: trainer never hit its idle timeout"
+    cat "$WORK/train.log"; exit 1
+fi
+rc=0; wait "$TRAIN_PID" || rc=$?
+TRAIN_PID=""
+[ "$rc" -eq 0 ] || {
+    echo "smoke_fresh: trainer exit $rc"; cat "$WORK/train.log"; exit 1; }
+
+python - "$PORT" <<'EOF'
+import http.client, json, sys, time
+
+port = int(sys.argv[1])
+# a trickle of requests across the final reload window closes the last
+# publication's serve_first span
+c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+for _ in range(8):
+    c.request("POST", "/predict", json.dumps({"rows": ["0:a 1:b"]}),
+              {"Content-Type": "application/json"})
+    resp = c.getresponse()
+    payload = json.loads(resp.read())
+    assert resp.status == 200, payload
+    time.sleep(0.3)
+c.close()
+# the fleet freshness spread: min/max + the stalest replica NAMED
+deadline = time.monotonic() + 60
+last = None
+while time.monotonic() < deadline:
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/healthz")
+        last = json.loads(c.getresponse().read())
+        c.close()
+        if "freshness_min_s" in last and last.get("healthy") == 2:
+            break
+    except Exception:
+        pass
+    time.sleep(0.5)
+assert last and last.get("healthy") == 2, f"fleet degraded: {last}"
+assert "freshness_min_s" in last and "freshness_max_s" in last, last
+assert "stalest_replica" in last, last
+fresh = [r for r in last["replicas"] if "data_freshness_s" in r]
+assert len(fresh) == 2, f"a replica never reported freshness: {last}"
+assert all(r["data_freshness_s"] >= 0 for r in fresh), last
+assert last["freshness_min_s"] <= last["freshness_max_s"], last
+print(f"smoke_fresh: fleet freshness OK (min {last['freshness_min_s']}s, "
+      f"max {last['freshness_max_s']}s, stalest replica "
+      f"{last['stalest_replica']})")
+EOF
+
+# ---- 5. drain, assemble the Δ, gate everything ----------------------------
+kill -TERM "$FLEET_PID"
+rc=0; wait "$FLEET_PID" || rc=$?
+FLEET_PID=""
+[ "$rc" -eq 0 ] || {
+    echo "smoke_fresh: fleet exit $rc"; cat "$WORK/fleet.log"; exit 1; }
+
+# the ingest/publish records + the cross-process span links are all in
+# ordinary JSONL — the trace id is the join key. 180s is the smoke's
+# generosity bound for a loaded CI runner; the report prints the real
+# decomposition for the ledger.
+python tools/freshness_report.py "$RUN" --checkpoint-dir "$WORK/ck" \
+    --bench-json "$BENCH_OUT" --max-delta-s 180
+
+grep -q '"kind": "ingest"' "$RUN/train_metrics.jsonl" || {
+    echo "smoke_fresh: no ingest records in the trainer stream"; exit 1; }
+grep -q '"kind": "publish"' "$RUN/train_metrics.jsonl" || {
+    echo "smoke_fresh: no publish records in the trainer stream"; exit 1; }
+# direct grep, not `cat | grep -q`: under pipefail grep's early exit
+# SIGPIPEs cat and fails the pipeline even when the record IS there
+grep -q '"name": "serve_first"' "$RUN"/serve_replica*.jsonl || {
+    echo "smoke_fresh: no serve_first span (the loop never closed)"; exit 1; }
+grep -q '"data_freshness_s"' "$RUN"/serve_replica*.jsonl || {
+    echo "smoke_fresh: no freshness-stamped serve window"; exit 1; }
+
+python tools/metrics_report.py "$RUN" --check
+
+# repo-root hygiene: running the tools from the root must leave no
+# stray artifact dirs behind (tools/__pycache__ and friends)
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+
+echo "smoke_fresh: OK"

@@ -59,6 +59,7 @@ RULES = ("XF110", "XF111")
 # the hot-path functions, by qualname pattern (nested closures included)
 HOT_QUALNAMES = (
     "*._fit", "*._fit.*",
+    "*._fit_tail", "*._fit_tail.*",
     "*._worker_loop", "*._worker_loop.*",
     "prefetch", "prefetch.*",
 )

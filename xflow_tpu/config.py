@@ -248,6 +248,26 @@ class DataConfig:
     # "" = off; else bad rows are appended to this JSONL file
     # (source path, batch/row index, label) for offline triage
     quarantine_path: str = ""
+    # ---- streaming source (docs/DATA.md "Streaming source") ----------
+    # "off" (default): the exact batch pipeline above — every existing
+    # stream stays byte-identical (no ingest records, no tail thread).
+    # "tail": follow-the-tail mode — watch the train_path shard set for
+    # new/growing libffm files, cut each poll's newly COMPLETED lines
+    # into an immutable spool segment, convert it on arrival into a
+    # packed .xfc cache (shardcache.write_shard_cache) so streamed data
+    # rides the same device-rate path batch training does, and stamp
+    # each segment with an ingest trace id (kind="ingest" record) the
+    # freshness tooling follows across the train/serve boundary.
+    stream: str = "off"
+    # directory poll cadence while tailing (seconds)
+    stream_poll_s: float = 0.25
+    # end-of-stream idle timeout: no new complete rows for this long
+    # ends the tail stream and the run (0 = follow forever). CI drills
+    # set it so a tail run is bounded.
+    stream_idle_s: float = 0.0
+    # where spool segments and their .xfc caches land ("" = an
+    # .xfstream dir next to the watched shards)
+    stream_dir: str = ""
 
 
 @dataclass(frozen=True)
@@ -348,6 +368,21 @@ class TrainConfig:
     # save leaves a partial dir; readers already ignore it, this
     # reclaims the space). 0 = keep everything.
     keep_checkpoints: int = 0
+    # in-run checkpoint publication cadence, in steps (0 = off): every
+    # publish_every-th step commits a checkpoint through the atomic
+    # staging contract WITH a publication.json sidecar stamped with the
+    # newest ingest trace id whose data contributed to that step, and
+    # emits one kind="publish" record plus one `publish` span carrying
+    # that trace id — the train-side half of the freshness loop
+    # (docs/SERVING.md "Freshness"). Requires checkpoint_dir.
+    publish_every: int = 0
+    # time-decayed sliding-window eval (streaming BucketAUC): each
+    # eval_every pass multiplies the persistent bucket histograms by
+    # this factor before folding the new pass in, so the logged
+    # eval_auc tracks the recent window instead of restarting from
+    # zero each pass. 0.0 (default) = per-pass-fresh histograms, the
+    # exact pre-knob behavior.
+    eval_window_decay: float = 0.0
     # model-health signals (docs/OBSERVABILITY.md "Health metrics"):
     # "norms" adds global grad-norm / update-norm / param-norm scalars to
     # every step's metrics output (fused into the jitted step — one

@@ -14,6 +14,8 @@ TPU analog of the reference's double-duty IO/compute threads.
 
 from __future__ import annotations
 
+import dataclasses
+import os
 import queue
 import subprocess
 import threading
@@ -476,3 +478,183 @@ def prefetch(
             except queue.Empty:
                 break
         t.join(timeout=10.0)
+
+
+# --------------------------------------------------------------- streaming
+@dataclasses.dataclass(frozen=True)
+class IngestSegment:
+    """One sealed unit of tail-followed input (data.stream=tail): the
+    newly COMPLETED lines of a watched shard, spooled into an immutable
+    segment file (plus its .xfc cache when conversion is on) and
+    stamped with the ingest trace context the freshness tooling follows
+    across the train/serve boundary (docs/SERVING.md "Freshness")."""
+
+    trace: str       # 16-hex ingest trace id (tracing.new_id)
+    seq: int         # monotone segment number within this follower
+    source: str      # the watched text shard the bytes came from
+    offset: int      # byte offset of the segment's start in `source`
+    rows: int        # labeled examples in the segment
+    bytes: int       # segment length in bytes
+    path: str        # the sealed spool file (immutable once yielded)
+    cache: str       # its .xfc sidecar ("" = text path)
+    ingest_ts: float # wall anchor: when the segment sealed
+
+
+def stream_dir_for(prefix: str, cfg: DataConfig) -> str:
+    """Where a tail follower spools segments: data.stream_dir, or an
+    `.xfstream` dir next to the watched shards."""
+    if cfg.stream_dir:
+        return cfg.stream_dir
+    return os.path.join(os.path.dirname(prefix) or ".", ".xfstream")
+
+
+class TailFollower:
+    """Follow-the-tail streaming source (data.stream=tail).
+
+    Watches the `<prefix>-NNNNN` shard set (or `prefix` itself when it
+    is a file) for new or growing libffm files. Each poll cuts every
+    shard's newly completed lines — a trailing row without its newline
+    is DEFERRED until more bytes land, never quarantined: a writer
+    mid-append is the normal case, not a malformed input — into one
+    immutable spool segment, converts it on arrival into a packed .xfc
+    cache (data.cache auto/on) so streamed data rides the same
+    device-rate path batch training does, and stamps it with a fresh
+    ingest trace id + wall anchor carried as a `kind="ingest"` record.
+    Consumers iterate sealed segments only, so the batch-count drift
+    guard downstream never sees a file change mid-pass.
+
+    Rotation: a shard whose size SHRANK below the follower's offset was
+    rotated/recreated — the offset resets to 0 and the new contents
+    stream from the top. `data.stream_idle_s` bounds the follow: no new
+    complete rows for that long ends the stream (0 = follow forever).
+
+    `clock`/`wall` are injectable for tests (monotonic pacing vs the
+    wall anchor stamped into records)."""
+
+    def __init__(
+        self,
+        prefix: str,
+        cfg: DataConfig,
+        appender: Optional[JsonlAppender] = None,
+        clock=time.monotonic,
+        wall=time.time,
+    ):
+        self._prefix = prefix
+        self._cfg = cfg
+        self._app = appender
+        self._poll_s = max(float(cfg.stream_poll_s), 0.01)
+        self._idle_s = max(float(cfg.stream_idle_s), 0.0)
+        self._dir = stream_dir_for(prefix, cfg)
+        self._clock = clock
+        self._wall = wall
+        self._offsets: dict[str, int] = {}
+        self._seq = 0
+        self._stop = threading.Event()
+
+    def _sources(self) -> list[str]:
+        from xflow_tpu.data.libffm import available_shards
+
+        if os.path.isfile(self._prefix):
+            return [self._prefix]
+        return available_shards(self._prefix)
+
+    def poll(self) -> list[IngestSegment]:
+        """One directory scan: seal and return every shard's newly
+        completed lines (possibly empty)."""
+        segs: list[IngestSegment] = []
+        for src in self._sources():
+            try:
+                size = os.path.getsize(src)
+            except OSError:
+                continue  # raced a rotation; next poll sees the truth
+            off = self._offsets.get(src, 0)
+            if size < off:
+                # rotation/truncation: the file restarted under us —
+                # follow the NEW contents from the top
+                off = self._offsets[src] = 0
+            if size <= off:
+                continue
+            with open(src, "rb") as f:
+                f.seek(off)
+                data = f.read(size - off)
+            nl = data.rfind(b"\n")
+            if nl < 0:
+                continue  # truncated tail row: defer, never quarantine
+            chunk = data[: nl + 1]
+            seg = self._seal(src, off, chunk)
+            self._offsets[src] = off + len(chunk)
+            if seg is not None:
+                segs.append(seg)
+        return segs
+
+    def _seal(self, src: str, off: int, chunk: bytes) -> Optional[IngestSegment]:
+        from xflow_tpu.data.libffm import count_rows
+        from xflow_tpu.telemetry import default_registry
+        from xflow_tpu.tracing import new_id
+
+        os.makedirs(self._dir, exist_ok=True)
+        spool = os.path.join(self._dir, "segment-%06d" % self._seq)
+        seq, self._seq = self._seq, self._seq + 1
+        tmp = spool + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, spool)
+        rows = count_rows(spool)
+        if rows == 0:
+            return None  # blank/label-less lines: the offset still advances
+        cache = ""
+        if self._cfg.cache in ("auto", "on"):
+            from xflow_tpu.data.shardcache import cache_path_for, write_shard_cache
+
+            try:
+                write_shard_cache(spool, self._cfg)
+                cache = cache_path_for(spool, self._cfg.cache_dir)
+            except Exception as e:
+                # conversion is an optimization: a failed build logs
+                # and the segment trains through the text path
+                print(
+                    f"xflow: warning: convert-on-arrival failed for "
+                    f"{spool!r} ({e}); training the segment from text",
+                    file=sys.stderr,
+                )
+        seg = IngestSegment(
+            trace=new_id(), seq=seq, source=src, offset=off, rows=rows,
+            bytes=len(chunk), path=spool, cache=cache,
+            ingest_ts=round(self._wall(), 6),
+        )
+        reg = default_registry()
+        reg.counter("data.ingest_segments").inc()
+        reg.counter("data.ingest_rows").inc(rows)
+        if self._app is not None:
+            self._app.append({
+                "kind": "ingest",
+                "trace": seg.trace,
+                "seq": seg.seq,
+                "source": seg.source,
+                "offset": seg.offset,
+                "rows": seg.rows,
+                "bytes": seg.bytes,
+                "cache": seg.cache,
+                "ingest_ts": seg.ingest_ts,
+            })
+        return seg
+
+    def segments(self) -> Iterator[IngestSegment]:
+        """The blocking segment stream: polls at stream_poll_s, ends on
+        close() or after stream_idle_s without new complete rows."""
+        last_new = self._clock()
+        while not self._stop.is_set():
+            segs = self.poll()
+            if segs:
+                last_new = self._clock()
+                for seg in segs:
+                    yield seg
+                continue
+            if self._idle_s and self._clock() - last_new >= self._idle_s:
+                return
+            self._stop.wait(self._poll_s)
+
+    def close(self) -> None:
+        self._stop.set()

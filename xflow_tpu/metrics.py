@@ -94,6 +94,16 @@ class BucketAUC(NamedTuple):
         neg = self.neg + np.bincount(idx, weights=(1.0 - y) * w, minlength=nb)
         return BucketAUC(pos=pos, neg=neg)
 
+    def decay(self, factor: float) -> "BucketAUC":
+        """Multiply both histograms by `factor` — the time-decayed
+        sliding-window step (train.eval_window_decay): counts are plain
+        sums, so an exponential decay before each fold turns the
+        lifetime accumulator into a recency-weighted window with an
+        effective length of ~1/(1-factor) eval passes. factor 0 resets
+        (per-pass-fresh); factor 1 is the undecayed lifetime sum."""
+        f = float(factor)
+        return BucketAUC(pos=self.pos * f, neg=self.neg * f)
+
     def compute(self) -> float:
         """AUC from bucket counts (ties within a bucket count 1/2)."""
         pos, neg = np.asarray(self.pos, np.float64), np.asarray(self.neg, np.float64)

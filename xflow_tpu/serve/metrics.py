@@ -54,6 +54,12 @@ SERVE_WINDOW_KEYS = (
     "generation",
     "step",
 )
+# optional window key (the OPTIONAL_SERVE_KEYS convention in
+# metrics_report): present only while the served generation carries a
+# publication sidecar (train.publish_every) — seconds between the
+# model's newest ingested row and the flush (docs/SERVING.md
+# "Freshness"). Absent = not measurable, never a fake 0.
+SERVE_FRESHNESS_KEY = "data_freshness_s"
 
 
 class ServeMetrics:
@@ -166,9 +172,18 @@ class ServeMetrics:
             self._app.append({**self._kind, "event": name, **extra})
 
     # ------------------------------------------------------------- flushing
-    def maybe_flush(self, generation: int, step: int, force: bool = False) -> Optional[dict]:
+    def maybe_flush(
+        self,
+        generation: int,
+        step: int,
+        force: bool = False,
+        freshness_s: Optional[float] = None,
+    ) -> Optional[dict]:
         """Emit a window record when the window elapsed (or `force`) and
-        traffic flowed; returns the record (tests) or None."""
+        traffic flowed; returns the record (tests) or None.
+        `freshness_s` (Generation.freshness_s) adds the optional
+        data_freshness_s key — None (unpublished checkpoint) leaves the
+        record byte-identical to a pre-freshness build."""
         now = time.perf_counter()
         with self._lock:
             elapsed = now - self._win_start
@@ -206,15 +221,26 @@ class ServeMetrics:
             # event can slip in between the fold and the write
             g, s = self._advance_seen_locked(generation, step)
             rec["generation"], rec["step"] = g, s
+            if freshness_s is not None:
+                rec[SERVE_FRESHNESS_KEY] = round(max(float(freshness_s), 0.0), 3)
             self._reset_window_locked()
             self._win_start = now
             self._app.append(rec)
         self._reg.gauge("serve.qps").set(rec["qps"])
         if rec["batches"]:
             self._reg.gauge("serve.batch_fill").set(rec["batch_fill"])
+        if freshness_s is not None:
+            self._reg.gauge("serve.data_freshness_s").set(
+                rec[SERVE_FRESHNESS_KEY]
+            )
         return rec
 
-    def close(self, generation: int = -1, step: int = -1) -> None:
-        self.maybe_flush(generation, step, force=True)
+    def close(
+        self,
+        generation: int = -1,
+        step: int = -1,
+        freshness_s: Optional[float] = None,
+    ) -> None:
+        self.maybe_flush(generation, step, force=True, freshness_s=freshness_s)
         self._app.append({**self._kind, "event": "final"})
         self._app.close()

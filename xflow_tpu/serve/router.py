@@ -189,6 +189,35 @@ class Backend:
         self._pool: deque = deque()
         self.requests = 0
         self.failures = 0
+        # freshness probe cache (docs/SERVING.md "Freshness"): the
+        # health loop parses each /healthz body and stores the
+        # replica's reported data_freshness_s + checkpoint step here,
+        # so the fleet /healthz can report min/max freshness across
+        # replicas (staggered reloads make them genuinely differ).
+        # None = the replica serves an unpublished checkpoint (or
+        # predates the field) — it simply stays out of the fleet Δ.
+        self.freshness_s: Optional[float] = None
+        self.health_step: int = -1
+
+    def note_health(self, body: bytes) -> None:
+        """Cache the freshness surface of one 200 /healthz body. A
+        malformed body is ignored (the probe already proved liveness;
+        freshness is observability, never an ejection signal)."""
+        try:
+            h = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            return
+        if not isinstance(h, dict):
+            return
+        step = h.get("step")
+        if isinstance(step, int):
+            self.health_step = step
+        f = h.get("data_freshness_s")
+        self.freshness_s = (
+            float(f)
+            if isinstance(f, (int, float)) and not isinstance(f, bool)
+            else None
+        )
 
     @property
     def addr(self) -> tuple:
@@ -337,6 +366,8 @@ class Router:
                 "GET", "/healthz", timeout=min(self.health_poll_s * 4, 5.0)
             )
             ok = status == 200
+            if ok:
+                b.note_health(body)
         except ConnectError:
             ok = False
         if ok:
@@ -641,16 +672,25 @@ class Router:
     # ------------------------------------------------------ health surface
     def health(self) -> dict:
         reps = []
+        fresh: list = []
         for b in self.backends:
-            reps.append({
+            rep = {
                 "replica": b.idx,
                 "port": b.addr[1],
                 "state": b.breaker.state,
                 "requests": b.requests,
                 "failures": b.failures,
-            })
+            }
+            if b.freshness_s is not None:
+                # last-probe snapshot (the poll cadence bounds its age);
+                # step rides along so an operator can see WHICH
+                # checkpoint the stale replica is pinned on
+                rep["data_freshness_s"] = round(b.freshness_s, 3)
+                rep["step"] = b.health_step
+                fresh.append((b.freshness_s, b.idx))
+            reps.append(rep)
         healthy = sum(1 for r in reps if r["state"] == CLOSED)
-        return {
+        out = {
             "ok": healthy > 0 and not self._draining,
             "router": True,
             "healthy": healthy,
@@ -658,6 +698,15 @@ class Router:
             "draining": self._draining,
             "inflight": self._inflight,
         }
+        if fresh:
+            # the fleet freshness spread (docs/SERVING.md "Freshness"):
+            # staggered reloads make replicas legitimately differ by up
+            # to the stagger + reload time; a replica stuck FAR behind
+            # the others is the failure the stalest pointer names
+            out["freshness_min_s"] = round(min(f for f, _ in fresh), 3)
+            out["freshness_max_s"] = round(max(f for f, _ in fresh), 3)
+            out["stalest_replica"] = max(fresh)[1]
+        return out
 
     def stats_view(self) -> dict:
         with self._stats_lock:

@@ -78,12 +78,35 @@ def parse_rows(rows: list, dcfg) -> tuple[list, list]:
 
 @dataclass
 class Generation:
-    """One loaded model generation: the serving tables + provenance."""
+    """One loaded model generation: the serving tables + provenance.
+
+    `publication` is the checkpoint's publication.json sidecar when the
+    trainer published it (train.publish_every, checkpoint
+    .read_publication): the ingest trace id + timestamps that make the
+    generation's DATA FRESHNESS measurable (docs/SERVING.md
+    "Freshness"). None for unpublished checkpoints — every freshness
+    surface (gauge, spans, /healthz field) simply stays absent, keeping
+    the off-path byte-identical. `reload_span` is the span id of the
+    swap that installed this generation (when a span sink is bound) —
+    the parent the first-served-prediction span links under."""
 
     tables: dict
     step: int
     gen: int
     loaded_ts: float = field(default_factory=time.time)
+    publication: Optional[dict] = None
+    reload_span: str = ""
+
+    def freshness_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds between the served model's newest ingested row and
+        `now` — the data_freshness_s gauge. None when this generation
+        carries no publication (or a malformed one): absence means
+        "not measurable", never a fake 0."""
+        pub = self.publication
+        ts = pub.get("ingest_ts") if isinstance(pub, dict) else None
+        if not isinstance(ts, (int, float)) or not np.isfinite(ts):
+            return None
+        return max((time.time() if now is None else now) - float(ts), 0.0)
 
 
 class ServeRunner:
@@ -232,8 +255,17 @@ class ServeRunner:
                     "generation"
                 )
             self._gen_counter += 1
+            # publication sidecar (train.publish_every): best-effort —
+            # read_publication returns None for unpublished steps and
+            # logs-and-downgrades on a damaged sidecar; a publication
+            # must never gate the swap itself
+            pub = ckpt.read_publication(
+                self.cfg.train.checkpoint_dir, int(step),
+                fmt=self.cfg.train.checkpoint_format,
+            )
             gen = Generation(
-                tables=state.tables, step=int(step), gen=self._gen_counter
+                tables=state.tables, step=int(step), gen=self._gen_counter,
+                publication=pub if isinstance(pub, dict) else None,
             )
             # the swap: one reference assignment — in-flight requests
             # hold the old Generation and finish on the old tables
@@ -241,21 +273,42 @@ class ServeRunner:
             if self.span_sink is not None:
                 # the span covers restore-read through swap — exactly
                 # the window a reload can lengthen request queues in
-                from xflow_tpu.tracing import emit_op_span
-
                 import jax
 
-                emit_op_span(
-                    self.span_sink,
-                    "reload" if is_reload else "serve_load",
-                    t0_wall,
-                    time.perf_counter() - t0,
-                    step=gen.step,
-                    generation=gen.gen,
-                    bytes=int(sum(
-                        x.nbytes for x in jax.tree.leaves(state.tables)
-                    )),
-                )
+                nbytes = int(sum(
+                    x.nbytes for x in jax.tree.leaves(state.tables)
+                ))
+                trace = pub.get("trace") if isinstance(pub, dict) else None
+                if isinstance(trace, str) and trace:
+                    # a PUBLISHED step's swap CONTINUES the ingest trace
+                    # (parented under the trainer's publish span) — the
+                    # publish→swap edge of the freshness Δ
+                    from xflow_tpu.tracing import emit_linked_span
+
+                    rec = emit_linked_span(
+                        self.span_sink,
+                        "reload" if is_reload else "serve_load",
+                        t0_wall,
+                        time.perf_counter() - t0,
+                        trace=trace,
+                        parent=pub.get("span") or None,
+                        step=gen.step,
+                        generation=gen.gen,
+                        bytes=nbytes,
+                    )
+                    gen.reload_span = rec["span"]
+                else:
+                    from xflow_tpu.tracing import emit_op_span
+
+                    emit_op_span(
+                        self.span_sink,
+                        "reload" if is_reload else "serve_load",
+                        t0_wall,
+                        time.perf_counter() - t0,
+                        step=gen.step,
+                        generation=gen.gen,
+                        bytes=nbytes,
+                    )
             return gen
 
     def maybe_reload(self) -> Optional[Generation]:

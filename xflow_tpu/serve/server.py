@@ -66,6 +66,14 @@ def parse_priority(value: Optional[str]) -> int:
     return -1 if value is not None and value.strip().lower() == "low" else 0
 
 
+def _freshness(gen) -> Optional[float]:
+    """Duck-typed: a generation without the freshness surface (test
+    fakes, pre-publication runners) reads as not-measurable, never an
+    error — absence of the gauge is the documented off state."""
+    fn = getattr(gen, "freshness_s", None)
+    return fn() if callable(fn) else None
+
+
 class ServeApp:
     """Wires runner + batcher + metrics + the device worker thread.
     Socket-free by itself (tests drive `handle_predict` directly); the
@@ -128,6 +136,11 @@ class ServeApp:
 
         self._fault_delay_s, self._fault_kill_batches = serve_faults_from_env()
         self._batches_served = 0
+        # first-served-prediction marker (docs/SERVING.md "Freshness"):
+        # the newest generation a batch has ANSWERED with — the worker
+        # emits one serve_first span when it advances, closing the
+        # ingest -> ... -> served-prediction trace
+        self._first_served_gen = -1
         self.t_start = time.perf_counter()
 
     def start(self) -> None:
@@ -145,7 +158,9 @@ class ServeApp:
                 # window that flushes here still steers the controller)
                 gen = self.runner.generation
                 if gen is not None:
-                    self._autotune(self.metrics.maybe_flush(gen.gen, gen.step))
+                    self._autotune(self.metrics.maybe_flush(
+                        gen.gen, gen.step, freshness_s=_freshness(gen),
+                    ))
                 continue
             t_batch = time.perf_counter()
             if self._fault_delay_s > 0:
@@ -172,6 +187,11 @@ class ServeApp:
                 continue
             t_done = time.perf_counter()
             device_s = t_done - t_batch
+            if gen.gen != self._first_served_gen:
+                # first answered batch of a new generation: the
+                # swap-to-first-serve edge of the freshness Δ
+                self._first_served_gen = gen.gen
+                self._first_serve_span(gen)
             self._trace_batch(group, spans, t_batch, t_done, gen, rung)
             queue_waits, totals = [], []
             n_rows = 0
@@ -192,7 +212,9 @@ class ServeApp:
                 len(group), n_rows, queue_waits, device_s, totals,
                 batch_size=rung,
             )
-            self._autotune(self.metrics.maybe_flush(gen.gen, gen.step))
+            self._autotune(self.metrics.maybe_flush(
+                gen.gen, gen.step, freshness_s=_freshness(gen),
+            ))
             self._batches_served += 1
             if (
                 self._fault_kill_batches
@@ -243,6 +265,30 @@ class ServeApp:
             )
 
     # ------------------------------------------------------------- tracing
+    def _first_serve_span(self, gen) -> None:
+        """One `serve_first` span per model generation, emitted when its
+        FIRST batch answers: carries the publication's ingest trace id
+        (parented under the reload swap span), so
+        tools/freshness_report.py can close the ingested-row ->
+        served-prediction loop at the exact instant predictions from
+        the new data became externally visible. Silent (byte-identical
+        streams) without a span sink or a published checkpoint."""
+        sink = self.runner.span_sink
+        pub = getattr(gen, "publication", None)
+        if sink is None or not isinstance(pub, dict):
+            return
+        trace = pub.get("trace")
+        if not isinstance(trace, str) or not trace:
+            return
+        from xflow_tpu.tracing import emit_linked_span
+
+        emit_linked_span(
+            sink, "serve_first", time.time(), 0.0,
+            trace=trace,
+            parent=getattr(gen, "reload_span", None) or pub.get("span") or None,
+            step=gen.step, generation=gen.gen,
+        )
+
     def _trace_batch(self, group, spans, t_batch, t_done, gen, rung) -> None:
         """Emit the shared device_batch span + each traced member's
         queue/device spans (the batch-membership link: N request trees
@@ -384,7 +430,7 @@ class ServeApp:
 
     def health(self) -> dict:
         gen = self.runner.generation
-        return {
+        out = {
             "ok": gen is not None,
             "generation": gen.gen if gen else 0,
             "step": gen.step if gen else -1,
@@ -392,6 +438,13 @@ class ServeApp:
             "brownout": self.batcher.brownout,
             "uptime_s": round(time.perf_counter() - self.t_start, 3),
         }
+        fresh = _freshness(gen)
+        if fresh is not None:
+            # present only for published checkpoints, so unpublished
+            # fleets keep the pre-freshness /healthz shape (the router
+            # probe and its fleet min/max read this field)
+            out["data_freshness_s"] = round(fresh, 3)
+        return out
 
     def stats(self) -> dict:
         from xflow_tpu.telemetry import default_registry
@@ -412,7 +465,11 @@ class ServeApp:
         if self._worker.is_alive():
             self._worker.join(timeout=30.0)
         gen = self.runner.generation
-        self.metrics.close(gen.gen if gen else -1, gen.step if gen else -1)
+        self.metrics.close(
+            gen.gen if gen else -1,
+            gen.step if gen else -1,
+            freshness_s=_freshness(gen),
+        )
 
 
 def _make_handler(app: ServeApp):

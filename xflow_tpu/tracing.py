@@ -257,3 +257,32 @@ def emit_op_span(appender, name: str, t0_wall: float, dur_s: float,
     }
     appender.append(rec)
     return rec
+
+
+def emit_linked_span(appender, name: str, t0_wall: float, dur_s: float,
+                     trace: str, parent: Optional[str] = None,
+                     span: Optional[str] = None, **attrs) -> dict:
+    """An operational span CARRYING a given trace id — the freshness
+    loop's cross-boundary links (docs/SERVING.md "Freshness"): the
+    trainer's `publish` span ships an INGEST trace id into the span
+    stream, the serve runner's reload swap and first-served-prediction
+    spans continue it on the other side of the train/serve boundary,
+    and tools/freshness_report.py reassembles the one tree that spans
+    ingested row -> served prediction. Like emit_op_span these are
+    rare operator-cadence events, always emitted (never sampled);
+    unlike it the trace (and optionally parent/span) ids are the
+    CALLER's, because the whole point is that they match across
+    processes."""
+    rec = {
+        "kind": "span",
+        "trace": trace,
+        "span": span or new_id(),
+        "name": name,
+        "t0": round(t0_wall, 6),
+        "dur_ms": round(max(dur_s, 0.0) * 1e3, 3),
+        **attrs,
+    }
+    if parent:
+        rec["parent"] = parent
+    appender.append(rec)
+    return rec

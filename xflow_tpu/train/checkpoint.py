@@ -104,6 +104,43 @@ def read_data_state(ckpt_dir: str, step: int, fmt: str = "npz") -> Optional[dict
     return ds
 
 
+def publication_path(ckpt_dir: str, step: int, fmt: str = "npz") -> str:
+    """Where a step's publication sidecar lives (train.publish_every,
+    docs/SERVING.md "Freshness"): inside the npz step dir — written
+    BEFORE the COMMITTED marker, so a committed publication is never
+    torn and prunes with its step — or as an
+    `orbax_step_N.publication.json` sibling (same contract as the
+    data_state sibling: presence implies a committed checkpoint)."""
+    if fmt == "orbax":
+        return os.path.join(ckpt_dir, f"orbax_step_{step}.publication.json")
+    return os.path.join(ckpt_dir, f"step_{step}", "publication.json")
+
+
+def read_publication(ckpt_dir: str, step: int, fmt: str = "npz") -> Optional[dict]:
+    """The publication context saved alongside checkpoint `step`
+    ({step, seq, trace, span, ingest_ts, consumed_ts, published_ts}),
+    or None. Absence is the NORMAL case — only publish-cadence saves
+    carry one — so missing is silent; an unreadable sidecar downgrades
+    with a logged warning, never gates the reload that found it (the
+    serve runner still swaps, it just cannot link the trace)."""
+    path = publication_path(ckpt_dir, step, fmt)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            pub = json.load(f)
+        if not isinstance(pub, dict):
+            raise ValueError(f"expected a JSON object, got {type(pub).__name__}")
+    except Exception as e:  # noqa: BLE001 — any unreadable publication
+        print(
+            f"# checkpoint: step {step} publication unreadable "
+            f"({type(e).__name__}: {e}); serving without a trace link",
+            file=sys.stderr,
+        )
+        return None
+    return pub
+
+
 def normalize_data_state(ds: dict) -> dict:
     """Fold any stored data_state version into the canonical
     topology-independent v2 shape the elastic resume consumes:
@@ -280,6 +317,7 @@ def save(
     state: TrainState,
     logical_widths: Optional[dict] = None,
     data_state: Optional[dict] = None,
+    publication: Optional[dict] = None,
 ) -> str:
     """Write a checkpoint; returns its path.
 
@@ -289,6 +327,12 @@ def save(
     as data_state.json BEFORE the COMMITTED marker, so a committed
     checkpoint either carries a complete data_state or (pre-v2 /
     data_state=None) none at all, never a torn one.
+
+    `publication` (optional) is the freshness trace context of a
+    publish-cadence save (train.publish_every): the newest contributing
+    ingest trace id + its wall anchors, written as publication.json
+    under the SAME pre-COMMITTED contract so the serve runner either
+    reads a complete publication or none.
 
     Host-gathered npz format: in multi-process mode every rank gathers
     (the allgather is collective) but only process 0 writes. Fine up to
@@ -345,6 +389,13 @@ def save(
                     json.dump(data_state, f)
 
             _write_atomic(os.path.join(path, DATA_STATE_FILE), write_ds)
+        if publication is not None:
+
+            def write_pub(p):
+                with open(p, "w") as f:
+                    json.dump(publication, f)
+
+            _write_atomic(os.path.join(path, "publication.json"), write_pub)
 
         def write_marker(p):
             with open(p, "w") as f:
@@ -401,6 +452,7 @@ def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
                     f"orbax_step_{s}",
                     os.path.basename(data_state_path(ckpt_dir, s, "orbax")),
                     f"orbax_step_{s}.meta.json",
+                    os.path.basename(publication_path(ckpt_dir, s, "orbax")),
                 ]
             )
         # stale-debris sweep, orbax flavor: a save killed mid-write leaves
@@ -665,7 +717,10 @@ def _flatten_native(tree: dict) -> dict:
 
 
 def save_orbax(
-    ckpt_dir: str, state: TrainState, data_state: Optional[dict] = None
+    ckpt_dir: str,
+    state: TrainState,
+    data_state: Optional[dict] = None,
+    publication: Optional[dict] = None,
 ) -> str:
     import orbax.checkpoint as ocp
 
@@ -714,6 +769,15 @@ def save_orbax(
                 json.dump(data_state, f)
 
         _write_atomic(data_state_path(ckpt_dir, step, fmt="orbax"), write_ds)
+    if publication is not None and jax.process_index() == 0:
+        # same sibling contract as data_state: written after the
+        # rename-commit, absence is just "not a publication"
+
+        def write_pub(p):
+            with open(p, "w") as f:
+                json.dump(publication, f)
+
+        _write_atomic(publication_path(ckpt_dir, step, fmt="orbax"), write_pub)
     return path
 
 

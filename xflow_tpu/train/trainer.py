@@ -421,6 +421,10 @@ class Trainer:
         self._examples_seen = 0
         self._examples_base = 0
         self._resume_data_state: Optional[dict] = None
+        # time-decayed eval window (train.eval_window_decay): the
+        # (BucketAUC, ll_sum, n_rows) accumulator the streaming eval
+        # passes decay-and-fold into; None until the first decayed pass
+        self._eval_window: Optional[tuple] = None
         # validate the guard mode at CONSTRUCTION (identical config on
         # every rank → rank-symmetric), not on the first bad batch
         self._guarded = nonfinite_guard_on(cfg)
@@ -940,6 +944,18 @@ class Trainer:
 
     def _fit(self, train_path: Optional[str] = None) -> TrainResult:
         cfg = self.cfg
+        if cfg.data.stream not in ("off", "tail"):
+            raise ValueError(
+                f"data.stream={cfg.data.stream!r}: expected 'off' or 'tail'"
+            )
+        if cfg.data.stream == "tail":
+            # follow-the-tail streaming fit (docs/DATA.md "Streaming
+            # ingest"): its own loop — the epoch-coordinated path counts
+            # batches per pass up front, which is meaningless over a
+            # growing input. stream=off never reaches this branch, so
+            # every existing stream stays byte-identical (the PR 9
+            # zero-overhead discipline; pinned by tests/test_freshness).
+            return self._fit_tail(train_path)
         res = TrainResult()
         # perf_counter for every DURATION (monotonic — wall clock jumps
         # under NTP slew); the records' `ts` field (JsonlAppender) is the
@@ -1606,6 +1622,331 @@ class Trainer:
             self.save_checkpoint()
         return res
 
+    # ---------------------------------------------------------- streaming fit
+    def _fit_tail(self, train_path: Optional[str] = None) -> TrainResult:
+        """Follow-the-tail streaming fit (`data.stream=tail`, docs/DATA.md
+        "Streaming ingest"): train on sealed ingest segments as a
+        TailFollower spools them off the growing input, and publish
+        committed checkpoints every `train.publish_every` steps — each
+        publication stamped with the NEWEST ingest trace whose rows a
+        completed step consumed, so the serve tier (and
+        tools/freshness_report.py) can measure data freshness end to
+        end.
+
+        Deliberately leaner than the epoch loop: single-process only
+        (the counting allgather the coordinated path leans on has no
+        meaning over an unbounded stream), no epochs (the stream IS one
+        open-ended pass), no profiler tiling or fault injectors. What
+        it keeps: the one-behind metrics staging (XF110), the
+        non-finite guard, heartbeat/hang bracketing around saves, and
+        signal-checkpoint handling — the operational contracts every
+        fit honors."""
+        cfg = self.cfg
+        if jax.process_count() > 1:
+            raise ValueError(
+                "data.stream=tail is single-process only: the tail "
+                "follower has no cross-rank batch coordination (shard "
+                "the stream upstream instead)"
+            )
+        from xflow_tpu.data.pipeline import TailFollower
+
+        res = TrainResult()
+        start = time.perf_counter()
+        steptimer = StepTimer()
+        registry = default_registry()
+        health = self._health
+        dump_restore = install_stack_dump_handler()
+        hang = HangWatchdog(cfg.train.hang_timeout_s)
+        sig_flag, sig_restore = self._install_signal_checkpoint()
+        hb_every = cfg.train.heartbeat_every
+        guard_halt = cfg.train.nonfinite_guard == "halt"
+        max_consec = cfg.train.nonfinite_max_consecutive
+        bad_run = 0
+        halted = False
+        pending_ok = None
+        pending_rec = None
+        self.heartbeat.append({"event": "start", "step": 0})
+        follower = TailFollower(
+            train_path or cfg.data.train_path, cfg.data,
+            appender=self.metrics if self.metrics.enabled else None,
+        )
+
+        def emit_pending_record() -> None:
+            # the same one-step-behind staging as _fit (XF110): reads
+            # happen after the NEXT dispatch made them free
+            nonlocal pending_rec
+            if pending_rec is None:
+                return
+            pm, at_step, at_examples, at_elapsed, counters = pending_rec
+            pending_rec = None
+            loss = float(pm["loss"])
+            finite = loss == loss and abs(loss) != float("inf")
+            if finite or not self._guarded:
+                res.last_loss = loss
+            rec = {
+                "step": at_step,
+                "epoch": 0,
+                "loss": loss if finite else None,
+                "examples": at_examples,
+                "elapsed_s": at_elapsed,
+            }
+            rec.update(steptimer.window_record(cost=self._step_cost()))
+            rec.update(hbm_window_fields(registry))
+            rec.update(health.window_record())
+            if counters:
+                rec["counters"] = counters
+            self.metrics.log(rec)
+
+        def check_pending() -> bool:
+            nonlocal pending_ok, bad_run
+            if pending_ok is None:
+                return False
+            m, at_step = pending_ok
+            pending_ok = None
+            if "update_ok" not in m or bool(m["update_ok"]):
+                bad_run = 0
+                return False
+            res.bad_steps += 1
+            bad_run += 1
+            self.metrics.log(
+                {
+                    "step": at_step,
+                    "nonfinite_skipped": True,
+                    "bad_steps": res.bad_steps,
+                }
+            )
+            print(
+                f"nonfinite update at step {at_step} discarded "
+                f"(total {res.bad_steps}, {bad_run} consecutive)",
+                file=sys.stderr,
+            )
+            return guard_halt or (0 < max_consec <= bad_run)
+
+        # freshness bookkeeping: the newest (trace, ingest_ts,
+        # consumed_ts) triple whose segment a completed step trained on
+        # — what the next publication stamps
+        newest: Optional[tuple] = None
+        pub_seq = 0
+        publish_every = cfg.train.publish_every
+        last_metrics = None
+        stop_sig = 0
+        try:
+            for seg in follower.segments():
+                seg_consumed = False
+                for batch, arrays in steptimer.batches(
+                    self._coordinated_batches([(0, seg.path)], quarantine=True)
+                ):
+                    arrays.pop("_shard", None)
+                    arrays = self._resolve_fullshard_overflow(batch, arrays)
+                    arrays = self._shard_batch(arrays)
+                    self.state, m = self.train_step(self.state, arrays)
+                    steptimer.dispatched(m, batch.num_rows)
+                    health.collect()
+                    health.staged(m)
+                    emit_pending_record()
+                    hang.tick()
+                    last_metrics = m
+                    res.steps += 1
+                    res.examples += batch.num_rows
+                    self._examples_seen += batch.num_rows
+                    self._epoch_pos = (0, res.steps)
+                    if not seg_consumed:
+                        # the first step over a segment marks its rows
+                        # as consumed; the wall clock here is the
+                        # ingest-to-train edge of the freshness Δ
+                        seg_consumed = True
+                        newest = (seg.trace, seg.ingest_ts, time.time())
+                    if hb_every and res.steps % hb_every == 0:
+                        self.heartbeat.append({"step": res.steps})
+                    if check_pending():
+                        halted = True
+                        break
+                    if self._guarded:
+                        pending_ok = (m, res.steps)
+                    if cfg.train.log_every and res.steps % cfg.train.log_every == 0:
+                        pending_rec = (
+                            m, res.steps, res.examples,
+                            round(time.perf_counter() - start, 3),
+                            registry.snapshot(),
+                        )
+                    if (
+                        cfg.train.checkpoint_dir
+                        and publish_every
+                        and res.steps % publish_every == 0
+                        and newest is not None
+                    ):
+                        emit_pending_record()
+                        self.heartbeat.append(
+                            {"step": res.steps, "event": "checkpoint"}
+                        )
+                        pub_seq += 1
+                        self._publish_checkpoint(newest, pub_seq)
+                        self.heartbeat.append({"step": res.steps})
+                        hang.tick()  # a slow publish is progress
+                        if (
+                            cfg.train.eval_every
+                            and cfg.data.test_path
+                            and pub_seq % cfg.train.eval_every == 0
+                        ):
+                            # in stream mode eval_every counts
+                            # PUBLICATIONS (there are no epochs); with
+                            # train.eval_window_decay the repeated
+                            # passes form the time-decayed window
+                            hang.tick()
+                            self.heartbeat.append(
+                                {"step": res.steps, "event": "eval"}
+                            )
+                            auc, ll = self.evaluate(dump=False, streaming=True)
+                            self.heartbeat.append({"step": res.steps})
+                            hang.tick()
+                            self.metrics.log(
+                                {
+                                    "step": res.steps,
+                                    "epoch": 0,
+                                    "eval_auc": auc if auc == auc else None,
+                                    "eval_logloss": ll if ll == ll else None,
+                                }
+                            )
+                    elif (
+                        cfg.train.checkpoint_dir
+                        and not publish_every
+                        and cfg.train.checkpoint_every
+                        and res.steps % cfg.train.checkpoint_every == 0
+                    ):
+                        # publish_every=0: plain checkpoint cadence,
+                        # no publication sidecar — freshness stays off
+                        emit_pending_record()
+                        self.heartbeat.append(
+                            {"step": res.steps, "event": "checkpoint"}
+                        )
+                        self.save_checkpoint()
+                        self.heartbeat.append({"step": res.steps})
+                        hang.tick()
+                    stop_sig = (
+                        int(sig_flag["sig"])
+                        if sig_flag and "sig" in sig_flag
+                        else 0
+                    )
+                    if stop_sig:
+                        break
+                if halted or stop_sig:
+                    break
+            if not halted and check_pending():
+                halted = True
+            if halted:
+                emit_pending_record()
+                self.metrics.log(
+                    {
+                        "nonfinite_halt": True,
+                        "step": res.steps,
+                        "bad_steps": res.bad_steps,
+                    }
+                )
+                if cfg.train.checkpoint_dir:
+                    self.save_checkpoint()
+                raise NonFiniteHalt(
+                    f"non-finite guard aborted at step {res.steps}: "
+                    f"{res.bad_steps} bad step(s), {bad_run} consecutive"
+                )
+            if stop_sig:
+                res.interrupted = stop_sig
+                self.metrics.log(
+                    {"interrupted": res.interrupted, "step": res.steps}
+                )
+                self.heartbeat.append(
+                    {"event": "interrupted", "step": res.steps}
+                )
+        except BaseException:
+            try:
+                emit_pending_record()
+            except BaseException:
+                pass
+            raise
+        finally:
+            sig_restore()
+            dump_restore()
+            hang.close()
+            follower.close()
+        steptimer.flush()
+        health.flush()
+        emit_pending_record()
+        if last_metrics is not None:
+            loss = float(last_metrics["loss"])
+            if (loss == loss and abs(loss) != float("inf")) or not self._guarded:
+                res.last_loss = loss
+        res.seconds = time.perf_counter() - start
+        res.epochs = 1 if res.steps else 0
+        final_rec = {
+            "final": True,
+            "steps": res.steps,
+            "examples": res.examples,
+            "elapsed_s": round(res.seconds, 3),
+            "occupancy": res.occupancy,
+        }
+        final_rec.update(steptimer.window_record(cost=self._step_cost()))
+        final_rec.update(hbm_window_fields(registry))
+        final_rec.update(health.window_record())
+        counters = registry.snapshot()
+        if counters:
+            final_rec["counters"] = counters
+        self.metrics.log(final_rec)
+        self.heartbeat.append({"event": "final", "step": res.steps})
+        if cfg.train.checkpoint_dir and res.steps:
+            # the tail commit publishes too when a publication cadence
+            # is on: the stream's last rows must become servable even
+            # when the idle timeout lands mid-cadence
+            if publish_every and newest is not None:
+                pub_seq += 1
+                self._publish_checkpoint(newest, pub_seq)
+            else:
+                self.save_checkpoint()
+        return res
+
+    def _publish_checkpoint(self, newest: tuple, seq: int) -> None:
+        """One in-run checkpoint PUBLICATION (docs/SERVING.md
+        "Freshness"): a normal committed save plus the publication.json
+        sidecar binding this step to the newest ingest trace whose rows
+        it trained on, a `kind="publish"` record, and a `publish` span
+        CARRYING that ingest trace id (tracing.emit_linked_span) — the
+        link freshness_report follows across the train/serve boundary.
+        The sidecar lands before the COMMITTED marker (checkpoint.save),
+        so a watcher never sees a committed step whose publication is
+        still in flight."""
+        from xflow_tpu.tracing import emit_linked_span, new_id
+
+        trace, ingest_ts, consumed_ts = newest
+        t0_wall, t0 = time.time(), time.perf_counter()
+        step = int(self.state.step)
+        pub = {
+            "step": step,
+            "seq": int(seq),
+            "trace": trace,
+            "span": new_id(),
+            "ingest_ts": round(float(ingest_ts), 6),
+            "consumed_ts": round(float(consumed_ts), 6),
+            "published_ts": round(t0_wall, 6),
+        }
+        self.save_checkpoint(publication=pub)
+        if self.metrics.enabled:
+            self.metrics.log(
+                {
+                    "kind": "publish",
+                    "step": step,
+                    "seq": int(seq),
+                    "trace": trace,
+                    "ingest_ts": pub["ingest_ts"],
+                    "published_ts": pub["published_ts"],
+                }
+            )
+            # record + span symmetry (the run_sync_round idiom): the
+            # span's end is the publication's commit instant — the
+            # publish edge of the freshness Δ decomposition
+            emit_linked_span(
+                self.metrics, "publish", t0_wall,
+                time.perf_counter() - t0,
+                trace=trace, span=pub["span"], step=step, seq=int(seq),
+            )
+
     # ------------------------------------------------------------------- eval
     def _local_pctrs(self, p_dev) -> np.ndarray:
         """This process's rows of the (possibly cross-process) pctr array."""
@@ -1760,6 +2101,24 @@ class Trainer:
             stats = gathered.reshape(-1, 2, stats.shape[0]).sum(axis=(0, 1))
         pos, neg = stats[:num_buckets], stats[num_buckets : 2 * num_buckets]
         ll_sum, n_rows = float(stats[-2]), float(stats[-1])
+        decay = float(self.cfg.train.eval_window_decay)
+        if decay > 0:
+            # time-decayed sliding window (train.eval_window_decay):
+            # fold the decayed accumulator from earlier eval passes into
+            # this pass's counts (BucketAUC.decay — counts are plain
+            # sums, so the fold is addition), then persist the folded
+            # state for the next pass. Runs AFTER the cross-process
+            # merge above, on identical allgathered stats, so every rank
+            # holds the same window. A bucket-count change resets the
+            # window (the histograms are not comparable).
+            prev = self._eval_window
+            if prev is not None and prev[0].pos.shape[0] == num_buckets:
+                pst = prev[0].decay(decay)
+                pos = pos + pst.pos
+                neg = neg + pst.neg
+                ll_sum += prev[1] * decay
+                n_rows += prev[2] * decay
+            self._eval_window = (BucketAUC(pos=pos, neg=neg), ll_sum, n_rows)
         if n_rows == 0:
             return float("nan"), float("nan")
         auc = BucketAUC(pos=pos, neg=neg).compute()
@@ -1910,7 +2269,7 @@ class Trainer:
             )),
         )
 
-    def save_checkpoint(self) -> None:
+    def save_checkpoint(self, publication: Optional[dict] = None) -> None:
         from xflow_tpu.train import checkpoint as ckpt
 
         t0_wall, t0 = time.time(), time.perf_counter()
@@ -1921,7 +2280,8 @@ class Trainer:
             # layout so export tools and differently-configured runs
             # read one format
             ckpt.save_orbax(
-                self.cfg.train.checkpoint_dir, self.state, data_state=data_state
+                self.cfg.train.checkpoint_dir, self.state,
+                data_state=data_state, publication=publication,
             )
         else:
             ckpt.save(
@@ -1929,6 +2289,7 @@ class Trainer:
                 self.state,
                 self._logical_widths(),
                 data_state=data_state,
+                publication=publication,
             )
         self._ckpt_span("checkpoint_save", t0_wall, t0, int(self.state.step))
         # retention + stale-uncommitted sweep AFTER the commit: the save
