@@ -24,6 +24,12 @@ committed step (docs/ROBUSTNESS.md).
     # corrupt an arbitrary file (no checkpoint-layout assumptions;
     # raw byte flips, so an npz fails at the zip layer instead)
     python tools/corrupt_ckpt.py --file ckpt/step_10/state.npz --mode truncate
+
+    # replica-tier drill (train.ckpt_replica_dir): poison the MIRROR
+    # instead of the primary — restore_tiered must detect the divergence
+    # and fall back to the primary copy of the same step
+    python tools/corrupt_ckpt.py --dir ckpt --tier replica \\
+        --replica-dir ckpt_replica --mode bitflip
 """
 
 from __future__ import annotations
@@ -52,6 +58,15 @@ def main(argv=None) -> int:
     tgt.add_argument("--file", help="corrupt this exact file instead")
     ap.add_argument("--format", default="npz", choices=("npz", "orbax"),
                     help="checkpoint format under --dir")
+    ap.add_argument("--tier", default="primary",
+                    choices=("primary", "replica"),
+                    help="which checkpoint tier to poison: primary = "
+                         "--dir itself; replica = the mirror under "
+                         "--replica-dir (identical layout, so the same "
+                         "injectors apply)")
+    ap.add_argument("--replica-dir", default=None,
+                    help="replica tier dir (train.ckpt_replica_dir); "
+                         "required with --tier replica")
     ap.add_argument("--step", type=int, default=None,
                     help="step to corrupt (default: newest committed)")
     ap.add_argument("--mode", default="truncate", choices=("truncate", "bitflip"))
@@ -73,6 +88,12 @@ def main(argv=None) -> int:
 
     kw = dict(keep_frac=args.keep_frac, offset=args.offset,
               count=args.count, seed=args.seed)
+    if args.tier == "replica" and not args.file:
+        if not args.replica_dir:
+            ap.error("--tier replica requires --replica-dir")
+        # the mirror keeps the primary's exact layout, so the tier
+        # switch is just a dir switch for the shared injectors
+        args.dir = args.replica_dir
     if args.file:
         if args.mode == "truncate":
             truncate_file(args.file, keep_frac=args.keep_frac)
@@ -89,6 +110,7 @@ def main(argv=None) -> int:
                                       mode=args.mode,
                                       target=args.target or "state", **kw)
     print(json.dumps({"corrupted": path, "mode": args.mode,
+                      "tier": args.tier,
                       "size": os.path.getsize(path)}))
     return 0
 

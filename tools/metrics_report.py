@@ -224,6 +224,29 @@ SYNC_KEYS = (
     "dur_ms",
 )
 SYNC_MODES = ("sync", "bounded", "async")
+# the key set every kind="ckpt" record carries (train/checkpoint
+# .AsyncCheckpointWriter._record — docs/OBSERVABILITY.md "Checkpoint
+# records"): one per async save outcome per tier (train.ckpt_async).
+# --check enforces all-or-none, the tier/event vocabularies, finite
+# non-negative timings, committed_ts >= queued_ts, a non-decreasing
+# skip counter, and the at-most-one-in-flight contract: per stream and
+# tier, a committed save's queued_ts must not precede the previous
+# committed save's committed_ts (overlapping queued→committed intervals
+# mean two writers raced one checkpoint dir)
+CKPT_KEYS = (
+    "step",
+    "tier",
+    "event",
+    "queued_ts",
+    "committed_ts",
+    "queue_ms",
+    "write_ms",
+    "bytes",
+    "skips",
+    "degraded",
+)
+CKPT_TIERS = ("primary", "replica")
+CKPT_EVENTS = ("committed", "skipped", "failed")
 # request-path span names come from xflow_tpu.tracing (the source of
 # truth): the cross-stream parenting gates below apply to those;
 # operational spans — reload/checkpoint_save/… — are one-span traces
@@ -647,13 +670,21 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
         last_ingest_seq = -1  # ingest streams: the follower's segment
         # counter only moves forward within a stream
         last_pub_seq = -1  # publish streams: publication counter ditto
+        last_ckpt_end: dict = {}  # ckpt streams: tier -> committed_ts of
+        # the last COMMITTED save — the at-most-one-in-flight gate
+        last_ckpt_skips = -1  # ckpt streams: skip counter only grows
         for i, rec in enumerate(records, 1):
             for key in STAMP_KEYS:
                 if key not in rec:
                     problems.append(f"{tag}: record {i} lacks {key!r}")
             if not _finite(rec.get("ts", 0.0)):
                 problems.append(f"{tag}: record {i} has non-numeric ts")
-            if "step" in rec:
+            if "step" in rec and kind != "ckpt":
+                # ckpt streams are exempt: the fit thread's skip
+                # records interleave with the writer thread's commit
+                # records (a step-10 skip can land before step 5's
+                # replica commit), so their ordering contract is the
+                # per-tier queued→committed interval gate below instead
                 step_recs += 1
                 if _finite(rec["step"]):
                     if rec["step"] < last_step:
@@ -862,6 +893,69 @@ def check_streams(streams: dict, files: list[str]) -> list[str]:
                     )
                 if _finite(sq):
                     last_pub_seq = max(last_pub_seq, int(sq))
+            if kind == "ckpt":
+                ck_missing = [k for k in CKPT_KEYS if k not in rec]
+                if ck_missing:
+                    problems.append(
+                        f"{tag}: record {i} lacks ckpt keys {ck_missing}"
+                    )
+                    continue
+                if rec["tier"] not in CKPT_TIERS:
+                    problems.append(
+                        f"{tag}: record {i} has unknown ckpt tier "
+                        f"{rec['tier']!r} (known: {', '.join(CKPT_TIERS)})"
+                    )
+                    continue
+                if rec["event"] not in CKPT_EVENTS:
+                    problems.append(
+                        f"{tag}: record {i} has unknown ckpt event "
+                        f"{rec['event']!r} (known: {', '.join(CKPT_EVENTS)})"
+                    )
+                    continue
+                bad_num = [
+                    k for k in ("queued_ts", "committed_ts", "queue_ms",
+                                "write_ms", "bytes", "skips")
+                    if not _finite(rec[k]) or rec[k] < 0
+                ]
+                if bad_num:
+                    problems.append(
+                        f"{tag}: record {i} has non-numeric or negative "
+                        f"{bad_num}"
+                    )
+                    continue
+                if not isinstance(rec["degraded"], bool):
+                    problems.append(
+                        f"{tag}: record {i} has a non-boolean degraded flag"
+                    )
+                if rec["committed_ts"] < rec["queued_ts"]:
+                    problems.append(
+                        f"{tag}: record {i} committed_ts "
+                        f"{rec['committed_ts']} < queued_ts "
+                        f"{rec['queued_ts']} — a save cannot commit "
+                        "before it was queued"
+                    )
+                if rec["skips"] < last_ckpt_skips:
+                    problems.append(
+                        f"{tag}: skip counter went backwards "
+                        f"({last_ckpt_skips} -> {rec['skips']}) at "
+                        f"record {i}"
+                    )
+                last_ckpt_skips = max(last_ckpt_skips, int(rec["skips"]))
+                if rec["event"] == "committed":
+                    # at most one save in flight: this save's queued
+                    # instant must not precede the previous committed
+                    # save's commit instant on the same tier (the
+                    # replica interval shares the job's queued_ts with
+                    # its primary, so the gate keys per tier)
+                    prev_end = last_ckpt_end.get(rec["tier"])
+                    if prev_end is not None and rec["queued_ts"] < prev_end:
+                        problems.append(
+                            f"{tag}: record {i} ({rec['tier']} step "
+                            f"{rec['step']}) queued at {rec['queued_ts']} "
+                            f"before the previous save committed at "
+                            f"{prev_end} — two saves in flight"
+                        )
+                    last_ckpt_end[rec["tier"]] = rec["committed_ts"]
             if kind == "autotune":
                 a_present = [k for k in AUTOTUNE_KEYS if k in rec]
                 a_missing = [k for k in AUTOTUNE_KEYS if k not in rec]
@@ -1332,7 +1426,62 @@ def render_health(streams: dict) -> str:
     fresh_lines = render_freshness(streams, newest)
     if fresh_lines:
         lines.extend(fresh_lines)
+    ckpt_lines = render_ckpt(streams, newest)
+    if ckpt_lines:
+        lines.extend(ckpt_lines)
     return "\n".join(lines)
+
+
+def render_ckpt(streams: dict, run_id: str) -> list[str]:
+    """The async-checkpoint section for the --health view
+    (docs/ROBUSTNESS.md "Async tiered checkpointing"): last committed
+    step per tier, committed/skip/failure counts, and whether the run
+    ever degraded to replica-only saves — the first durability question
+    an operator asks after an incident: what is the newest restorable
+    step, and on which volume? Empty when the run carries no
+    kind="ckpt" records (train.ckpt_async off)."""
+    last_by_tier: dict = {}  # tier -> (ts, step)
+    committed = 0
+    failed = 0
+    skips = 0
+    degraded = False
+    seen = False
+    for (rid, _rank, kind, _gen), recs in sorted(streams.items(), key=str):
+        if kind != "ckpt" or rid != run_id:
+            continue
+        for r in recs:
+            seen = True
+            skips = max(skips, r.get("skips", 0) or 0)
+            if r.get("degraded") is True:
+                degraded = True
+            if r.get("event") == "failed":
+                failed += 1
+            if r.get("event") != "committed":
+                continue
+            committed += 1
+            tier = r.get("tier", "?")
+            cand = (r.get("committed_ts", 0.0), r.get("step"))
+            if tier not in last_by_tier or cand > last_by_tier[tier]:
+                last_by_tier[tier] = cand
+    if not seen:
+        return []
+    out = ["  checkpoints (kind=ckpt, train.ckpt_async):"]
+    for tier in CKPT_TIERS:
+        if tier in last_by_tier:
+            out.append(
+                f"    {tier}: last committed step {last_by_tier[tier][1]}"
+            )
+        else:
+            out.append(f"    {tier}: no committed saves")
+    out.append(
+        f"    committed {committed}  skipped {skips}  failed {failed}"
+    )
+    if degraded:
+        out.append(
+            "    DEGRADED: primary tier failed — saves land replica-only"
+            "  <-- DEGRADED"
+        )
+    return out
 
 
 def render_freshness(streams: dict, run_id: str) -> list[str]:

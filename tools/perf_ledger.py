@@ -408,7 +408,7 @@ def collect(root: str, extra: list[str]) -> list[dict]:
     for pattern in ("BENCH_r*.json", "BENCH_SCALE*.json", "MULTICHIP_r*.json",
                     "BENCH_SERVE*.json", "BENCH_TRACE*.json",
                     "BENCH_LAB*.json", "BENCH_PIPELINE*.json",
-                    "BENCH_FRESH*.json"):
+                    "BENCH_FRESH*.json", "BENCH_CKPT*.json"):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             add(path)
     for path in extra:

@@ -1,0 +1,291 @@
+#!/usr/bin/env bash
+# Durability gate (docs/ROBUSTNESS.md "Async tiered checkpointing") —
+# the async save pipeline + tier-2 replica, end to end:
+#
+# 1. Checkpoint-stall collapse: the SAME emulated-slow-disk save
+#    (XFLOW_FAULT_CKPT_SLOW_S_PER_MB) is timed from the fit thread in
+#    synchronous mode (round 1) and async mode (round 2); the p99 stall
+#    lands in BENCH_CKPT.json and gates through perf_ledger --regress
+#    (ckpt_stall_p99_ms is latency-shaped: the async round must not
+#    regress upward). Hard gate here: async p99 < half the sync p99 and
+#    within the same order as a plain train step.
+# 2. Kill mid-async-save: a SIGKILL lands while the background writer
+#    is mid-write (slow-paced). The torn step dir must be uncommitted
+#    debris; the relaunch walks back, replays the stream, and the final
+#    checkpoint accounts for every example exactly.
+# 3. Replica serve drill: a trainer commits to primary+replica tiers; a
+#    NEWER step ships with its primary copy digest-POISONED and only
+#    the replica intact, while serve_bench drives closed-loop load. The
+#    watcher must hot-reload the new step from the replica tier with
+#    ZERO dropped requests.
+# 4. tools/metrics_report.py --check green over the kind="ckpt" streams
+#    (schema, tier/event vocab, at-most-one-in-flight intervals).
+#
+# Standalone:    bash tools/smoke_durable.sh [workdir]
+# From pytest:   tests/test_durable_ckpt.py::test_smoke_durable_script
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ROOT="$(pwd)"
+
+WORK="${1:-}"
+# bench datapoint destination: the repo root ONLY standalone (the
+# per-PR record); under pytest it stays in the workdir
+BENCH_OUT="$ROOT/BENCH_CKPT.json"
+SERVE_PID=""
+BENCH_PID=""
+cleanup() {
+    if [ -n "$SERVE_PID" ]; then kill -9 "$SERVE_PID" 2>/dev/null || true; fi
+    if [ -n "$BENCH_PID" ]; then kill -9 "$BENCH_PID" 2>/dev/null || true; fi
+    if [ -n "${TMP_WORK:-}" ]; then rm -rf "$TMP_WORK"; fi
+}
+trap cleanup EXIT
+if [ -z "$WORK" ]; then
+    TMP_WORK="$(mktemp -d)"
+    WORK="$TMP_WORK"
+else
+    BENCH_OUT="$WORK/BENCH_CKPT.json"
+fi
+
+export JAX_PLATFORMS=cpu
+# single CPU device (xargs trims; an empty result must UNSET the var —
+# XLA treats a whitespace-only value as a flags FILE to open and aborts)
+XLA_FLAGS="$(printf '%s\n' ${XLA_FLAGS:-} \
+    | grep -v xla_force_host_platform_device_count | xargs || true)"
+if [ -n "$XLA_FLAGS" ]; then export XLA_FLAGS; else unset XLA_FLAGS; fi
+
+MODEL_ARGS=(--model lr --log2-slots 12
+            --set model.num_fields=6 --set data.max_nnz=8)
+RUN="$WORK/run_durable"
+mkdir -p "$RUN"
+
+python -m xflow_tpu gen-data "$WORK/train" --shards 1 --rows 3200 \
+    --fields 6 --ids-per-field 50 --seed 0 >/dev/null
+python -m xflow_tpu gen-data "$WORK/elastic" --shards 1 --rows 600 \
+    --fields 6 --ids-per-field 50 --seed 3 >/dev/null
+python -m xflow_tpu gen-data "$WORK/reqs" --shards 1 --rows 512 \
+    --fields 6 --ids-per-field 50 --seed 9 --truth-seed 0 >/dev/null
+
+# ---- 1. checkpoint-stall collapse (BENCH_CKPT.json rounds 1/2) ------------
+# the emulated slow disk makes the write cost real on tmpfs; the fault
+# paces on whichever thread does the writing, so sync mode stalls the
+# fit thread and async mode does not — exactly the contract under test
+python - "$WORK" "$BENCH_OUT" <<'EOF'
+import json, os, sys, time
+
+from xflow_tpu.config import Config, override
+from xflow_tpu.train.trainer import Trainer
+
+work, out = sys.argv[1], sys.argv[2]
+os.environ["XFLOW_FAULT_CKPT_SLOW_S_PER_MB"] = "6"  # ~0.3s per staged file
+
+
+def p99(ms):
+    return sorted(ms)[max(int(len(ms) * 0.99) - 1, 0)]
+
+
+def stall_round(tag, async_on):
+    cfg = override(Config(), **{
+        "model.name": "lr", "data.log2_slots": 12, "model.num_fields": 6,
+        "data.max_nnz": 8, "data.batch_size": 64, "train.epochs": 1,
+        "data.train_path": f"{work}/train",
+        "train.pred_dump": False, "train.log_every": 0,
+        "train.checkpoint_dir": f"{work}/bench_ck_{tag}",
+        "train.ckpt_async": async_on,
+        # NOT under run_durable/: both rounds run in THIS process, so
+        # they share one run_id — merged they would trip the
+        # compile-once gate; each file passes --check on its own
+        "train.metrics_path": f"{work}/bench_{tag}.jsonl",
+    })
+    t = Trainer(cfg)
+    res = t.fit()
+    step_ms = res.seconds / max(res.steps, 1) * 1000.0
+    stalls = []
+    for _ in range(12):
+        t0 = time.perf_counter()
+        t.save_checkpoint()
+        stalls.append((time.perf_counter() - t0) * 1000.0)
+        if t._ckpt_writer is not None:
+            t._ckpt_writer.drain()  # every submit must land (no skips)
+    if t._ckpt_writer is not None:
+        t._ckpt_writer.close()
+        t._ckpt_writer = None
+    t.metrics.close()
+    return round(p99(stalls), 3), round(step_ms, 3)
+
+
+sync_p99, step_ms = stall_round("sync", False)
+async_p99, _ = stall_round("async", True)
+recs = [
+    {"metric": "ckpt_stall_p99_ms", "value": sync_p99, "unit": "ms",
+     "round": 1, "mode": "sync", "train_step_ms": step_ms},
+    {"metric": "ckpt_stall_p99_ms", "value": async_p99, "unit": "ms",
+     "round": 2, "mode": "async", "train_step_ms": step_ms},
+]
+json.dump(recs, open(out, "w"), indent=1)
+assert async_p99 < sync_p99 * 0.5, (
+    f"async stall p99 {async_p99}ms did not collapse vs sync "
+    f"{sync_p99}ms")
+assert async_p99 < max(step_ms * 2.0, 50.0), (
+    f"async stall p99 {async_p99}ms is not step-sized "
+    f"(train step {step_ms}ms)")
+print(f"smoke_durable: stall collapse OK (sync p99 {sync_p99}ms -> "
+      f"async p99 {async_p99}ms; train step {step_ms}ms)")
+EOF
+
+# --root "$WORK": gate THIS series only — the repo-root trajectory has
+# its own smoke (the explicit file folds in wherever BENCH_OUT lives)
+python tools/perf_ledger.py --root "$WORK" "$BENCH_OUT" --regress >/dev/null || {
+    echo "smoke_durable: perf_ledger --regress failed on BENCH_CKPT.json"
+    exit 1; }
+
+# ---- 2. kill mid-async-save, walk-back resume, exact accounting -----------
+ELASTIC_ARGS=(--train "$WORK/elastic" --epochs 2 --batch-size 100
+    --no-mesh --checkpoint-dir "$WORK/eck" "${MODEL_ARGS[@]}"
+    --set train.pred_dump=false --set train.checkpoint_every=5
+    --set train.resume=true
+    --set train.metrics_path="$RUN/elastic_metrics.jsonl")
+
+# phase A: sync saves, die after step 7 -> committed exactly [5]
+XFLOW_FAULT_KILL_STEP=7 \
+    python -m xflow_tpu train "${ELASTIC_ARGS[@]}" \
+    >/dev/null 2>"$WORK/phaseA.log" && {
+    echo "smoke_durable: phase A was supposed to be killed"; exit 1; }
+[ -e "$WORK/eck/step_5/COMMITTED" ] || {
+    echo "smoke_durable: phase A left no committed step 5"
+    cat "$WORK/phaseA.log"; exit 1; }
+
+# phase B: resume from 5, async on, the step-10 save paced to ~30s; the
+# kill at local step 6 (global 11) lands mid-write
+XFLOW_FAULT_KILL_STEP=6 XFLOW_FAULT_CKPT_SLOW_S_PER_MB=600 \
+    XFLOW_FAULT_CKPT_TIER=primary \
+    python -m xflow_tpu train "${ELASTIC_ARGS[@]}" --set train.ckpt_async=true \
+    >/dev/null 2>"$WORK/phaseB.log" && {
+    echo "smoke_durable: phase B was supposed to be killed"; exit 1; }
+grep -q "resumed from step 5" "$WORK/phaseB.log" || {
+    echo "smoke_durable: phase B did not resume from step 5"
+    cat "$WORK/phaseB.log"; exit 1; }
+[ -d "$WORK/eck/step_10" ] || {
+    echo "smoke_durable: phase B left no torn step-10 debris"; exit 1; }
+[ -e "$WORK/eck/step_10/COMMITTED" ] && {
+    echo "smoke_durable: the mid-write kill still committed step 10"
+    exit 1; }
+
+# phase C: faults off — the walk-back resume sweeps the debris and
+# finishes with exact accounting
+python -m xflow_tpu train "${ELASTIC_ARGS[@]}" --set train.ckpt_async=true \
+    >/dev/null 2>"$WORK/phaseC.log" || {
+    echo "smoke_durable: phase C failed"; cat "$WORK/phaseC.log"; exit 1; }
+python - "$WORK/eck" <<'EOF'
+from xflow_tpu.train.checkpoint import committed_steps, read_data_state
+import sys
+
+ck = sys.argv[1]
+steps = committed_steps(ck)
+assert steps[0] == 12, f"final committed steps {steps}"
+ds = read_data_state(ck, 12)
+assert ds["completed"] and ds["examples"] == 1200, ds
+print(f"smoke_durable: kill-mid-async-save OK (committed {steps}, "
+      f"{ds['examples']} examples accounted)")
+EOF
+
+# ---- 3. serve hot reload from the replica tier under load -----------------
+# commit steps 10..50 to BOTH tiers (sync mode mirrors inline — the
+# replica machinery under test is mirror_step, shared with the writer)
+python -m xflow_tpu train --train "$WORK/train" "${MODEL_ARGS[@]}" \
+    --epochs 1 --batch-size 64 --checkpoint-dir "$WORK/ck" \
+    --set train.ckpt_replica_dir="$WORK/ck_replica" \
+    --set train.checkpoint_every=10 --set train.pred_dump=false \
+    --set train.log_every=0 >/dev/null 2>"$WORK/serve_train.log"
+
+stage() {  # atomic checkpoint shipping: payload under a temp name, one rename
+    python - "$1" "$2" "$3" <<'EOF'
+import os, shutil, sys
+src, dst, step = sys.argv[1], sys.argv[2], sys.argv[3]
+os.makedirs(dst, exist_ok=True)
+tmp = os.path.join(dst, f".staging_{step}")
+if os.path.exists(tmp):
+    shutil.rmtree(tmp)
+shutil.copytree(os.path.join(src, f"step_{step}"), tmp)
+os.replace(tmp, os.path.join(dst, f"step_{step}"))
+EOF
+}
+# the server starts on step 40, both tiers healthy
+stage "$WORK/ck" "$WORK/serve_ck" 40
+stage "$WORK/ck_replica" "$WORK/serve_replica" 40
+# step 50 ships with a digest-POISONED primary copy; only the replica
+# tier holds good bytes (staged before the primary so the watcher never
+# sees the poisoned step without its fallback)
+cp -r "$WORK/ck/step_50" "$WORK/poison_scratch"
+mkdir -p "$WORK/poison"
+mv "$WORK/poison_scratch" "$WORK/poison/step_50"
+python tools/corrupt_ckpt.py --dir "$WORK/poison" --mode bitflip >/dev/null
+
+python -m xflow_tpu serve --checkpoint-dir "$WORK/serve_ck" "${MODEL_ARGS[@]}" \
+    --port 0 --window-ms 3 --max-batch 64 --poll-s 0.3 --no-mesh \
+    --metrics-path "$RUN/serve_rank0.jsonl" \
+    --set train.ckpt_replica_dir="$WORK/serve_replica" \
+    --set serve.metrics_every_s=1 \
+    >"$WORK/serve_ready.json" 2>"$WORK/serve.log" &
+SERVE_PID=$!
+for i in $(seq 1 240); do
+    [ -s "$WORK/serve_ready.json" ] && break
+    kill -0 "$SERVE_PID" 2>/dev/null || {
+        echo "smoke_durable: server died during startup"
+        cat "$WORK/serve.log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$WORK/serve_ready.json" ] || {
+    echo "smoke_durable: server never became ready"
+    cat "$WORK/serve.log"; exit 1; }
+PORT=$(python -c "import json,sys; print(json.load(open(sys.argv[1]))['port'])" \
+    "$WORK/serve_ready.json")
+grep -q '"step": 40' "$WORK/serve_ready.json" || {
+    echo "smoke_durable: server did not start at step 40"
+    cat "$WORK/serve_ready.json"; exit 1; }
+
+python tools/serve_bench.py --url "http://127.0.0.1:$PORT" \
+    --data "$WORK/reqs-00000" --duration 8 --concurrency 4 \
+    --rows-per-request 4 \
+    >"$WORK/bench_report.json" 2>"$WORK/bench.log" &
+BENCH_PID=$!
+sleep 2.5
+# ship step 50 mid-load: replica (good bytes) first, then the poisoned
+# primary — the union watcher sees 50, the primary copy digest-fails,
+# the replica loads, zero requests drop
+stage "$WORK/ck_replica" "$WORK/serve_replica" 50
+stage "$WORK/poison" "$WORK/serve_ck" 50
+rc=0; wait "$BENCH_PID" || rc=$?
+BENCH_PID=""
+[ "$rc" -eq 0 ] || {
+    echo "smoke_durable: loadgen saw failed requests during the replica reload"
+    cat "$WORK/bench_report.json" "$WORK/serve.log"; exit 1; }
+python - "$WORK/bench_report.json" <<'EOF'
+import json, sys
+
+rec = json.load(open(sys.argv[1]))
+assert rec["errors"] == 0, rec
+assert rec["steps"] == [40, 50], f"served steps {rec['steps']} != [40, 50]"
+assert rec["gen_flips"] >= 1, f"no hot-reload generation flip: {rec}"
+print(f"smoke_durable: replica hot reload OK (served steps {rec['steps']}, "
+      f"{rec['requests']} requests, 0 dropped)")
+EOF
+grep -q "failed to load" "$WORK/serve.log" || {
+    echo "smoke_durable: the poisoned primary never failed a load "
+    cat "$WORK/serve.log"; exit 1; }
+kill -9 "$SERVE_PID" 2>/dev/null || true
+SERVE_PID=""
+
+# ---- 4. telemetry gates over the ckpt streams -----------------------------
+python tools/metrics_report.py "$RUN"/*.jsonl --check || {
+    echo "smoke_durable: metrics_report --check failed"; exit 1; }
+for f in "$WORK/bench_sync.jsonl" "$WORK/bench_async.jsonl"; do
+    python tools/metrics_report.py "$f" --check || {
+        echo "smoke_durable: metrics_report --check failed on $f"; exit 1; }
+done
+python tools/metrics_report.py "$WORK/bench_async.jsonl" --health \
+    >"$WORK/health.txt"
+grep -q "checkpoints (kind=ckpt" "$WORK/health.txt" || {
+    echo "smoke_durable: --health has no checkpoint section"; exit 1; }
+
+rm -rf "$ROOT/tools/__pycache__" "$ROOT/__pycache__"
+echo "smoke_durable: OK"

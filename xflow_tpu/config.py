@@ -368,6 +368,38 @@ class TrainConfig:
     # save leaves a partial dir; readers already ignore it, this
     # reclaims the space). 0 = keep everything.
     keep_checkpoints: int = 0
+    # asynchronous checkpointing (docs/ROBUSTNESS.md "Async tiered
+    # checkpointing"): at checkpoint cadence the fit loop only SNAPSHOTS
+    # — copy_to_host_async() on every table/optimizer leaf plus the
+    # synchronously-captured data_state — and hands the snapshot to a
+    # single background writer thread that serializes, digests, stages
+    # the sidecars, and writes the COMMITTED marker last (the same
+    # atomicity/walk-back contract as a synchronous save; a crash
+    # mid-async-write is just the uncommitted-dir walk-back). At most
+    # one save is in flight: a cadence hit while one is pending is a
+    # logged, counted SKIP, never a queue; the halt/signal/end-of-fit
+    # saves drain the writer so the run's last state is always durable.
+    # Every async save emits one kind="ckpt" record per tier. Requires
+    # a single process (the host-gather collectives cannot run on a
+    # background thread; multi-process logs once and falls back to
+    # synchronous saves). Default off = today's synchronous save path,
+    # byte-identical (pinned by test).
+    ckpt_async: bool = False
+    # tier-2 checkpoint replica dir ("" = off): every committed step is
+    # MIRRORED here — copy, digest re-verify of the replica's own bytes,
+    # then the replica's own COMMITTED marker — so a lost/poisoned
+    # primary volume costs no committed state. restore walks the UNION
+    # of both tiers newest-step-first (primary preferred per step), and
+    # under ckpt_async an ENOSPC/IO failure on the primary DEGRADES the
+    # writer to replica-only saves instead of killing training
+    # (docs/ROBUSTNESS.md failure matrix). The serve watcher reads the
+    # same union, so a digest-poisoned primary hot-reloads from the
+    # replica with zero dropped requests.
+    ckpt_replica_dir: str = ""
+    # replica-tier retention: keep_checkpoints semantics applied to
+    # ckpt_replica_dir (0 = keep everything). Independent of the
+    # primary's knob so the cheap tier can keep a deeper history.
+    keep_replica_checkpoints: int = 0
     # in-run checkpoint publication cadence, in steps (0 = off): every
     # publish_every-th step commits a checkpoint through the atomic
     # staging contract WITH a publication.json sidecar stamped with the

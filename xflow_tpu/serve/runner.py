@@ -222,12 +222,18 @@ class ServeRunner:
         return self._gen.step if self._gen else -1
 
     def latest_committed_step(self) -> Optional[int]:
+        """Newest committed step across BOTH checkpoint tiers — the
+        replica (train.ckpt_replica_dir) counts, so a degraded trainer
+        writing replica-only still advances the watcher."""
         from xflow_tpu.train import checkpoint as ckpt
 
-        cdir = self.cfg.train.checkpoint_dir
-        if self.cfg.train.checkpoint_format == "orbax":
-            return ckpt.latest_orbax_step(cdir)
-        return ckpt.latest_step(cdir)
+        fmt = self.cfg.train.checkpoint_format
+        dirs = [self.cfg.train.checkpoint_dir]
+        rdir = self.cfg.train.ckpt_replica_dir
+        if rdir and rdir not in dirs:
+            dirs.append(rdir)
+        steps = [s for d in dirs for s in ckpt.tier_steps(d, fmt)]
+        return max(steps, default=None)
 
     def load(self) -> Generation:
         """Load the newest committed checkpoint (walk-back on corrupt
@@ -240,11 +246,15 @@ class ServeRunner:
         with self._reload_lock:
             is_reload = self._gen is not None
             t0_wall, t0 = time.time(), time.perf_counter()
-            state, step = ckpt.restore_any(
+            # tiered walk: a digest-poisoned primary step restores from
+            # the replica mirror before falling back to an older step —
+            # serving never drops a request over one bad volume
+            state, step, src = ckpt.restore_tiered(
                 self.cfg.train.checkpoint_dir,
                 self._template(),
                 fmt=self.cfg.train.checkpoint_format,
                 verify=self.cfg.train.checkpoint_verify,
+                replica_dir=self.cfg.train.ckpt_replica_dir or None,
             )
             if self._gen is not None and step <= self._gen.step:
                 # restore_any walked back to (or re-found) what we
@@ -259,8 +269,9 @@ class ServeRunner:
             # read_publication returns None for unpublished steps and
             # logs-and-downgrades on a damaged sidecar; a publication
             # must never gate the swap itself
+            # read the sidecar from the tier that actually restored
             pub = ckpt.read_publication(
-                self.cfg.train.checkpoint_dir, int(step),
+                src, int(step),
                 fmt=self.cfg.train.checkpoint_format,
             )
             gen = Generation(

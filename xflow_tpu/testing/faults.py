@@ -34,8 +34,10 @@ silent there.
 
 from __future__ import annotations
 
+import errno
 import os
 import random
+import time
 from typing import Iterable, Optional
 
 import numpy as np
@@ -245,6 +247,62 @@ def corrupt_orbax_checkpoint(ckpt_dir: str, step: Optional[int] = None,
             f"target={target!r}: expected manifest|largest|data_state"
         )
     return _apply(victim, mode, **kw)
+
+
+# --------------------------------------------------------------- disk faults
+def ckpt_write_fault(tier: str):
+    """Disk-fault injector for checkpoint WRITES — the async-tiered
+    durability drills' trigger (docs/ROBUSTNESS.md "Async tiered
+    checkpointing"). Returns a callback `fault(tmp_path)` the writer
+    invokes on each staged temp file just before its commit rename, or
+    None when no fault is armed — resolved ONCE per save per tier, so
+    the ENOSPC byte budget is per-save, not cumulative across a run.
+
+    Env contract (tools/smoke_durable.sh and tests/test_durable_ckpt.py
+    export these):
+    - XFLOW_FAULT_CKPT_ENOSPC_BYTES: once the save's cumulative staged
+      bytes pass this budget, raise OSError(ENOSPC) — a volume filling
+      up mid-write. The trainer's async writer latches degraded mode
+      and falls back to replica-only saves.
+    - XFLOW_FAULT_CKPT_SLOW_S_PER_MB: sleep size/1e6 * this per staged
+      file — a slow disk. Widens the in-flight window so the
+      kill-mid-async-save and skip-on-busy drills land deterministically.
+    - XFLOW_FAULT_CKPT_TIER: restrict to "primary" or "replica"
+      (default: both tiers).
+
+    Injection rides the npz temp+replace path and the replica mirror's
+    per-file copy; the orbax main step dir writes through orbax's own
+    machinery and is NOT injected (its sidecars are).
+    """
+    target = os.environ.get("XFLOW_FAULT_CKPT_TIER")
+    if target is not None and target != tier:
+        return None
+
+    def _num(name: str, cast, default):
+        try:
+            return cast(os.environ.get(name, default) or default)
+        except ValueError:
+            return cast(default)
+
+    enospc = _num("XFLOW_FAULT_CKPT_ENOSPC_BYTES", int, 0)
+    slow = _num("XFLOW_FAULT_CKPT_SLOW_S_PER_MB", float, 0.0)
+    if enospc <= 0 and slow <= 0:
+        return None
+    written = {"bytes": 0}
+
+    def fault(tmp_path: str) -> None:
+        size = os.path.getsize(tmp_path)
+        if slow > 0:
+            time.sleep(size / 1e6 * slow)
+        written["bytes"] += size
+        if 0 < enospc < written["bytes"]:
+            raise OSError(
+                errno.ENOSPC,
+                "injected ENOSPC (XFLOW_FAULT_CKPT_ENOSPC_BYTES)",
+                tmp_path,
+            )
+
+    return fault
 
 
 # -------------------------------------------------------------- kill faults

@@ -20,7 +20,10 @@ import os
 import re
 import shutil
 import sys
+import threading
+import time
 import zlib
+from dataclasses import dataclass
 from typing import Optional
 
 import jax
@@ -285,28 +288,45 @@ def _flatten(state: TrainState, logical_widths: Optional[dict] = None) -> dict:
     return flat
 
 
-def _write_atomic(path: str, writer) -> None:
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY fd: make a rename/replace that already landed
+    in `path` durable against power/kernel loss. rename alone is not —
+    default ext4/xfs can journal the name change before (or after) a
+    crash boundary, so a commit-by-rename (orbax's finalize, our
+    COMMITTED markers) needs the parent directory synced too."""
+    dfd = os.open(path or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def _write_atomic(path: str, writer, fault=None) -> None:
     """Write a file through a temp name + fsync + os.replace + dir fsync,
     so a crash mid-write can never leave a half-written file under the
     final name (a truncated `state.npz` in a COMMITTED dir would defeat
     the commit-marker protocol — the marker only witnesses ordering, not
     write atomicity). The fsyncs extend the guarantee to power/kernel
     loss: without them, default ext4/xfs can journal the rename before
-    the data blocks land, committing a zero-filled file."""
+    the data blocks land, committing a zero-filled file.
+
+    `fault` (testing/faults.ckpt_write_fault) is the disk-fault seam:
+    called with the temp path after `writer` lands it, BEFORE the
+    replace — an injected ENOSPC/slow-write fires exactly where a real
+    one would, and the finally sweeps the temp so the final name never
+    appears."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         writer(tmp)
+        if fault is not None:
+            fault(tmp)
         fd = os.open(tmp, os.O_RDONLY)
         try:
             os.fsync(fd)
         finally:
             os.close(fd)
         os.replace(tmp, path)
-        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        fsync_dir(os.path.dirname(path))
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
@@ -347,66 +367,98 @@ def save(
     the COMMITTED marker is written last.
     """
     step = int(state.step)
-    path = os.path.join(ckpt_dir, f"step_{step}")
     flat = _flatten(state, logical_widths)  # collective: all ranks participate
     if jax.process_index() == 0:
-        if os.path.isdir(path) and not os.path.exists(
-            os.path.join(path, "COMMITTED")
-        ):
-            shutil.rmtree(path)
-        os.makedirs(path, exist_ok=True)
-        def write_npz(p):
-            # a file OBJECT, not a path: np.savez appends ".npz" to bare
-            # paths, which would break the temp-name + os.replace dance
-            with open(p, "wb") as f:
-                np.savez(f, **flat)
-
-        _write_atomic(os.path.join(path, "state.npz"), write_npz)
-        # v3 metadata: the canonical LOGICAL layout (npz always stores
-        # [S, K], _unpack_host), the writer's world size (informational
-        # — restore reshards into whatever mesh is live), and per-array
-        # digests over exactly the bytes a reader gets back, so a
-        # silent flip fails the restore instead of training garbage
-        meta = {
-            "step": step,
-            "tables": sorted(state.tables),
-            "format": "npz",
-            "version": CHECKPOINT_VERSION,
-            "world_size": jax.process_count(),
-            "layout": {k: list(np.asarray(v).shape) for k, v in flat.items()},
-            "digests": {k: array_digest(v) for k, v in flat.items()},
-        }
-
-        def write_json(p):
-            with open(p, "w") as f:
-                json.dump(meta, f)
-
-        _write_atomic(os.path.join(path, "meta.json"), write_json)
-        if data_state is not None:
-
-            def write_ds(p):
-                with open(p, "w") as f:
-                    json.dump(data_state, f)
-
-            _write_atomic(os.path.join(path, DATA_STATE_FILE), write_ds)
-        if publication is not None:
-
-            def write_pub(p):
-                with open(p, "w") as f:
-                    json.dump(publication, f)
-
-            _write_atomic(os.path.join(path, "publication.json"), write_pub)
-
-        def write_marker(p):
-            with open(p, "w") as f:
-                f.write("ok\n")
-
-        # commit marker last: readers treat directories without it as partial
-        _write_atomic(os.path.join(path, "COMMITTED"), write_marker)
+        path = write_flat(
+            ckpt_dir, flat, step, data_state=data_state, publication=publication
+        )
+    else:
+        path = os.path.join(ckpt_dir, f"step_{step}")
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+    return path
+
+
+def write_flat(
+    ckpt_dir: str,
+    flat: dict,
+    step: int,
+    data_state: Optional[dict] = None,
+    publication: Optional[dict] = None,
+    tier: str = "primary",
+) -> str:
+    """The WRITE phase of an npz save: host arrays in, committed step
+    dir out. No collectives and no device access, so it runs on the
+    caller thread (`save`) or the async writer thread
+    (AsyncCheckpointWriter) identically — the atomicity contract
+    (uncommitted-dir cleanup, per-file temp+replace+fsync, COMMITTED
+    marker last) lives here once. `tier` names the destination for the
+    env-gated disk-fault injectors (testing/faults.ckpt_write_fault,
+    resolved once per call — zero cost unset)."""
+    from xflow_tpu.testing.faults import ckpt_write_fault
+
+    fault = ckpt_write_fault(tier)
+    path = os.path.join(ckpt_dir, f"step_{step}")
+    if os.path.isdir(path) and not os.path.exists(
+        os.path.join(path, "COMMITTED")
+    ):
+        shutil.rmtree(path)
+    os.makedirs(path, exist_ok=True)
+
+    def write_npz(p):
+        # a file OBJECT, not a path: np.savez appends ".npz" to bare
+        # paths, which would break the temp-name + os.replace dance
+        with open(p, "wb") as f:
+            np.savez(f, **flat)
+
+    _write_atomic(os.path.join(path, "state.npz"), write_npz, fault=fault)
+    # v3 metadata: the canonical LOGICAL layout (npz always stores
+    # [S, K], _unpack_host), the writer's world size (informational
+    # — restore reshards into whatever mesh is live), and per-array
+    # digests over exactly the bytes a reader gets back, so a
+    # silent flip fails the restore instead of training garbage
+    meta = {
+        "step": step,
+        "tables": sorted(
+            k.split("/", 1)[1] for k in flat if k.startswith("tables/")
+        ),
+        "format": "npz",
+        "version": CHECKPOINT_VERSION,
+        "world_size": jax.process_count(),
+        "layout": {k: list(np.asarray(v).shape) for k, v in flat.items()},
+        "digests": {k: array_digest(v) for k, v in flat.items()},
+    }
+
+    def write_json(p):
+        with open(p, "w") as f:
+            json.dump(meta, f)
+
+    _write_atomic(os.path.join(path, "meta.json"), write_json, fault=fault)
+    if data_state is not None:
+
+        def write_ds(p):
+            with open(p, "w") as f:
+                json.dump(data_state, f)
+
+        _write_atomic(os.path.join(path, DATA_STATE_FILE), write_ds, fault=fault)
+    if publication is not None:
+
+        def write_pub(p):
+            with open(p, "w") as f:
+                json.dump(publication, f)
+
+        _write_atomic(
+            os.path.join(path, "publication.json"), write_pub, fault=fault
+        )
+
+    def write_marker(p):
+        with open(p, "w") as f:
+            f.write("ok\n")
+
+    # commit marker last: readers treat directories without it as partial
+    _write_atomic(os.path.join(path, "COMMITTED"), write_marker, fault=fault)
     return path
 
 
@@ -484,57 +536,101 @@ def prune_checkpoints(ckpt_dir: str, keep: int, fmt: str = "npz") -> list[str]:
     return removed
 
 
-def restore_any(ckpt_dir: str, like: TrainState, fmt: str = "npz", verify: str = "auto"):
+def tier_steps(ckpt_dir: str, fmt: str = "npz") -> list[int]:
+    """Committed steps of ONE tier dir, newest first (format-dispatched)."""
+    return orbax_steps(ckpt_dir) if fmt == "orbax" else committed_steps(ckpt_dir)
+
+
+def restore_any(
+    ckpt_dir: str,
+    like: TrainState,
+    fmt: str = "npz",
+    verify: str = "auto",
+    replica_dir: Optional[str] = None,
+):
     """Self-healing restore: walk back from the newest committed step.
 
-    Returns (state, step). A checkpoint that fails to load — truncated
-    npz, bit-flipped orbax shard, a digest mismatch against the meta
-    written at save (CheckpointDigestError — the SILENT-corruption
-    case no container-level check catches), unreadable metadata — is
-    logged with the reason and SKIPPED, and the previous committed step
-    is tried, instead of one corrupt file killing a resumable run (or,
-    worse, restoring garbage). Raises FileNotFoundError when no
-    checkpoint exists at all, RuntimeError (listing every failure) when
-    none of the existing ones loads. `verify` is the digest policy
-    (train.checkpoint_verify): "auto" verifies whenever digests exist
-    and the arrays are host-visible; "off" skips."""
-    steps = orbax_steps(ckpt_dir) if fmt == "orbax" else committed_steps(ckpt_dir)
+    Returns (state, step) — the tiered walk with the source dir dropped
+    (restore_tiered keeps it for callers that read sidecars)."""
+    state, step, _src = restore_tiered(
+        ckpt_dir, like, fmt=fmt, verify=verify, replica_dir=replica_dir
+    )
+    return state, step
+
+
+def restore_tiered(
+    ckpt_dir: str,
+    like: TrainState,
+    fmt: str = "npz",
+    verify: str = "auto",
+    replica_dir: Optional[str] = None,
+):
+    """Self-healing, replica-aware restore: walk the UNION of committed
+    steps across the primary and (optional) tier-2 replica dir, newest
+    step first, primary tier first within a step.
+
+    Returns (state, step, source_dir) — source_dir is where the step
+    actually loaded from, so callers read the matching sidecars
+    (data_state, publication) from the SAME tier. A candidate that
+    fails to load — truncated npz, bit-flipped orbax shard, a digest
+    mismatch against the meta written at save (CheckpointDigestError —
+    the SILENT-corruption case no container-level check catches),
+    unreadable metadata — is logged with the reason and SKIPPED, and
+    the next candidate (the step's other tier, then the previous
+    committed step) is tried, instead of one corrupt file killing a
+    resumable run (or, worse, restoring garbage). Raises
+    FileNotFoundError when no checkpoint exists in any tier,
+    RuntimeError (listing every failure) when none of the existing ones
+    loads. `verify` is the digest policy (train.checkpoint_verify):
+    "auto" verifies whenever digests exist and the arrays are
+    host-visible; "off" skips."""
+    dirs = [ckpt_dir]
+    if replica_dir and replica_dir != ckpt_dir:
+        dirs.append(replica_dir)
+    by_dir = {d: set(tier_steps(d, fmt)) for d in dirs}
+    steps = sorted(set().union(*by_dir.values()), reverse=True)
     if not steps:
         raise FileNotFoundError(
             f"no {'orbax' if fmt == 'orbax' else 'committed'} checkpoint "
-            f"under {ckpt_dir!r}"
+            f"under {' or '.join(repr(d) for d in dirs)}"
         )
     errors = []
     for step in steps:
-        try:
-            if fmt == "orbax":
-                state = restore_orbax(ckpt_dir, like, step=step, verify=verify)
-            else:
-                state = restore(ckpt_dir, like, step=step, verify=verify)
-        except Exception as e:  # noqa: BLE001 — every failure mode of a
-            # corrupt file (BadZipFile, zlib.error, OSError, orbax/
-            # tensorstore errors, shape mismatches) must take the
-            # walk-back path; each is logged with its reason below
-            print(
-                f"# checkpoint: step {step} failed to load "
-                f"({type(e).__name__}: {e}); trying the previous "
-                "committed step",
-                file=sys.stderr,
-            )
-            errors.append((step, e))
-            continue
-        if errors:
-            print(
-                f"# checkpoint: restored step {step} after skipping "
-                f"{len(errors)} unreadable checkpoint(s): "
-                + ", ".join(str(s) for s, _ in errors),
-                file=sys.stderr,
-            )
-        return state, step
+        for d in dirs:
+            if step not in by_dir[d]:
+                continue
+            try:
+                if fmt == "orbax":
+                    state = restore_orbax(d, like, step=step, verify=verify)
+                else:
+                    state = restore(d, like, step=step, verify=verify)
+            except Exception as e:  # noqa: BLE001 — every failure mode of
+                # a corrupt file (BadZipFile, zlib.error, OSError, orbax/
+                # tensorstore errors, shape mismatches) must take the
+                # walk-back path; each is logged with its reason below
+                tier = "replica" if len(dirs) > 1 and d == dirs[-1] else "primary"
+                print(
+                    f"# checkpoint: step {step} ({tier} tier) failed to "
+                    f"load ({type(e).__name__}: {e}); trying the next "
+                    "candidate",
+                    file=sys.stderr,
+                )
+                errors.append((d, step, e))
+                continue
+            if errors:
+                print(
+                    f"# checkpoint: restored step {step} from {d!r} after "
+                    f"skipping {len(errors)} unreadable candidate(s): "
+                    + ", ".join(f"step {s} in {dd!r}" for dd, s, _ in errors),
+                    file=sys.stderr,
+                )
+            return state, step, d
     raise RuntimeError(
-        f"no loadable checkpoint under {ckpt_dir!r} — all "
-        f"{len(errors)} candidates failed: "
-        + "; ".join(f"step {s}: {type(e).__name__}: {e}" for s, e in errors)
+        f"no loadable checkpoint under {' or '.join(repr(d) for d in dirs)}"
+        f" — all {len(errors)} candidates failed: "
+        + "; ".join(
+            f"step {s} ({d}): {type(e).__name__}: {e}" for d, s, e in errors
+        )
     )
 
 
@@ -729,6 +825,12 @@ def save_orbax(
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, state._asdict(), force=True)
     if jax.process_index() == 0:
+        # orbax commits by renaming its tmp dir under the final name —
+        # make that rename durable (fsync_dir): without the parent-dir
+        # sync a host crash can reorder the commit past already-synced
+        # data, resurfacing the tmp name (the npz path gets the same
+        # guarantee from _write_atomic's own dir fsync)
+        fsync_dir(os.path.abspath(ckpt_dir))
         # v3 meta sibling (same commit protocol as the data_state
         # sibling: written AFTER orbax's rename-commit, its absence is
         # just an unverified restore). Digests cover the NATIVE stored
@@ -982,6 +1084,458 @@ def restore_orbax(
     return TrainState(
         tables=tables, opt_state=opt_state, step=jnp.asarray(stored["step"])
     )
+
+
+# ------------------------------------------------- async tiered writer
+#
+# train.ckpt_async (docs/ROBUSTNESS.md "Async tiered checkpointing"):
+# the fit loop snapshots and returns; one background thread owns every
+# byte that leaves for disk — serialize, digest, sidecars, COMMITTED
+# marker last (write_flat: the exact synchronous contract), then the
+# tier-2 replica mirror (train.ckpt_replica_dir) and retention on both
+# tiers. The reference's defining robustness property is that workers
+# never block on state movement (ps-lite's async push/pull); this is
+# that property applied to durability.
+
+
+class SaveSnapshot:
+    """Device-state capture for one async save (train.ckpt_async).
+
+    Construction runs on the FIT-LOOP thread and MUST finish the host
+    gather before returning: every train-step engine donates the input
+    state (donate_argnums=(0,)), so the device buffers behind these
+    leaves are deleted the moment the fit loop dispatches the next
+    step — a reference pinned for the writer thread would read dead
+    arrays. copy_to_host_async() is issued on every leaf first so the
+    blocking device_get is the D2H transfer TAIL, not a fresh serial
+    copy; the expensive half of a save (serialize + digest + fsync +
+    rename) stays on the writer thread. Single-process only: _flatten's
+    multihost allgather is a collective no side thread may run (the
+    trainer gates ckpt_async on process_count == 1)."""
+
+    def __init__(self, state: TrainState, logical_widths: Optional[dict] = None):
+        self.widths = logical_widths or {}
+        self.step = int(state.step)
+        self.nbytes = 0
+        for leaf in jax.tree.leaves((state.tables, state.opt_state)):
+            if isinstance(leaf, jax.Array):
+                leaf.copy_to_host_async()
+            self.nbytes += int(getattr(leaf, "nbytes", 0))
+        # host copies, gathered BEFORE the fit loop can donate the
+        # device buffers away (TrainState is a pytree: device_get maps
+        # every jax.Array leaf to numpy, structure unchanged)
+        self.state = jax.device_get(state)
+
+    def materialize(self) -> dict:
+        """{label: host array} in the canonical logical npz layout."""
+        return _flatten(self.state, self.widths)
+
+
+@dataclass
+class SaveJob:
+    """One submitted async save: the snapshot plus everything the writer
+    thread needs to reproduce save()/save_orbax() byte-for-byte.
+    Captured at SUBMIT time on the fit-loop thread — data_state holds
+    host-side counters that keep moving, so the writer must persist the
+    cadence step's view, never a later one."""
+
+    snapshot: SaveSnapshot
+    ckpt_dir: str
+    fmt: str = "npz"
+    replica_dir: str = ""
+    keep: int = 0
+    keep_replica: int = 0
+    data_state: Optional[dict] = None
+    publication: Optional[dict] = None
+    queued_ts: float = 0.0
+
+
+def _copier(src: str):
+    """_write_atomic writer callback that lands a copy of `src`."""
+
+    def write(p):
+        shutil.copyfile(src, p)
+
+    return write
+
+
+def _copytree_verified(src: str, dst: str, fault=None) -> str:
+    """Recursive file copy with a per-file read-BACK crc check: the
+    mirror must verify the bytes the copy actually landed on replica
+    media, not trust the kernel's success return (a flip through bad
+    RAM/NIC/controller is exactly the fault the tier exists to absorb).
+    `fault` is the replica-targeted disk-fault seam."""
+    os.makedirs(dst, exist_ok=True)
+    for root, _dirs, files in os.walk(src):
+        rel = os.path.relpath(root, src)
+        out_root = dst if rel == "." else os.path.join(dst, rel)
+        os.makedirs(out_root, exist_ok=True)
+        for name in files:
+            sp, dp = os.path.join(root, name), os.path.join(out_root, name)
+            with open(sp, "rb") as f:
+                blob = f.read()
+            with open(dp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            if fault is not None:
+                fault(dp)
+            with open(dp, "rb") as f:
+                back = f.read()
+            if zlib.crc32(back) != zlib.crc32(blob):
+                raise CheckpointDigestError(
+                    f"replica mirror of {sp!r}: read-back crc mismatch — "
+                    "the copy landed corrupted"
+                )
+    return dst
+
+
+def mirror_step(
+    primary_dir: str, replica_dir: str, step: int, fmt: str = "npz"
+) -> str:
+    """Mirror committed checkpoint `step` into the tier-2 replica dir
+    (train.ckpt_replica_dir); returns the replica path. Idempotent: an
+    already-committed replica step is left untouched.
+
+    npz: every file of the primary step dir copies through the same
+    temp+replace+fsync dance the save used, the replica's OWN state.npz
+    bytes re-verify against the mirrored meta's digests (a torn or
+    flipped copy fails HERE, loudly, instead of at a future restore),
+    and the replica's COMMITTED marker lands last — so the replica obeys
+    the exact reader contract the primary does. orbax: the step dir
+    copies file-by-file with a read-back crc check into a tmp name the
+    stale-debris sweep already knows, commits by rename + dir fsync,
+    then the sidecar siblings follow (their presence implies the commit,
+    same as the primary's contract).
+
+    Disk faults aim here via tier="replica"
+    (testing/faults.ckpt_write_fault)."""
+    from xflow_tpu.testing.faults import ckpt_write_fault
+
+    fault = ckpt_write_fault("replica")
+    os.makedirs(replica_dir, exist_ok=True)
+    if fmt == "orbax":
+        src = os.path.join(primary_dir, f"orbax_step_{step}")
+        dst = os.path.join(replica_dir, f"orbax_step_{step}")
+        if not os.path.isdir(dst):
+            tmp = os.path.join(
+                replica_dir,
+                f"orbax_step_{step}.orbax-checkpoint-tmp-mirror{os.getpid()}",
+            )
+            try:
+                _copytree_verified(src, tmp, fault=fault)
+                os.rename(tmp, dst)
+            finally:
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp, ignore_errors=True)
+            fsync_dir(replica_dir)
+        for name in (
+            f"orbax_step_{step}.meta.json",
+            os.path.basename(data_state_path(primary_dir, step, "orbax")),
+            os.path.basename(publication_path(primary_dir, step, "orbax")),
+        ):
+            sp = os.path.join(primary_dir, name)
+            if os.path.exists(sp) and not os.path.exists(
+                os.path.join(replica_dir, name)
+            ):
+                _write_atomic(
+                    os.path.join(replica_dir, name), _copier(sp), fault=fault
+                )
+        return dst
+    src = os.path.join(primary_dir, f"step_{step}")
+    dst = os.path.join(replica_dir, f"step_{step}")
+    if os.path.exists(os.path.join(dst, "COMMITTED")):
+        return dst
+    if os.path.isdir(dst):
+        shutil.rmtree(dst)  # uncommitted debris from a crashed mirror
+    os.makedirs(dst, exist_ok=True)
+    for name in ("state.npz", "meta.json", DATA_STATE_FILE, "publication.json"):
+        sp = os.path.join(src, name)
+        if os.path.exists(sp):
+            _write_atomic(os.path.join(dst, name), _copier(sp), fault=fault)
+    # digest re-verify the REPLICA's own bytes before committing it: the
+    # digests were taken over the arrays at save time, so this closes
+    # the whole primary-write -> copy -> replica-media loop
+    meta = read_meta(replica_dir, step)
+    digests = meta.get("digests") if isinstance(meta, dict) else None
+    if digests:
+        with np.load(os.path.join(dst, "state.npz")) as data:
+            for name in data.files:
+                verify_digest(name, data[name], digests, dst)
+
+    def write_marker(p):
+        with open(p, "w") as f:
+            f.write("ok\n")
+
+    _write_atomic(os.path.join(dst, "COMMITTED"), write_marker, fault=fault)
+    return dst
+
+
+class AsyncCheckpointWriter:
+    """The single background checkpoint writer (train.ckpt_async).
+
+    At most ONE save in flight: submit() while a save is pending is a
+    logged, counted skip — never a queue (a queue under a slow disk
+    would pile up host copies of the whole state without bound). The
+    thread runs write_flat/save_orbax verbatim, so a crash mid-async-
+    write leaves exactly today's uncommitted debris and the walk-back
+    restore covers it. drain() blocks until idle — the halt/signal/
+    end-of-fit saves use it so the run's last state is durable before
+    fit returns; close() drains and stops the thread.
+
+    Failure policy: an OSError on the PRIMARY tier (disk full, dead
+    volume) latches DEGRADED — this and every later save lands
+    replica-only (a full save, not a mirror) and training never stops;
+    a non-IO primary failure falls back to the replica for that save
+    without latching. Replica failures are logged and counted only.
+    Every outcome emits one kind="ckpt" record per tier into `sink` (a
+    thread-safe jsonl.JsonlAppender; metrics_report --check gates the
+    schema and the one-in-flight invariant), plus — with ckpt_spans —
+    one checkpoint_save span per committed write so saves still overlay
+    request-latency timelines."""
+
+    def __init__(self, sink=None, ckpt_spans: bool = False):
+        self._sink = sink
+        self._ckpt_spans = ckpt_spans
+        self._lock = threading.Lock()
+        self._job: Optional[SaveJob] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self._wake = threading.Event()
+        self._stop = False
+        self.skips = 0
+        self.saves = 0  # committed tier-writes (primary + replica)
+        self.failures = 0
+        self.degraded = False
+        self.last_step: dict = {}  # tier -> last committed step
+        self.last_error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- control
+    def submit(self, job: SaveJob) -> bool:
+        """Hand one save to the writer; False = a save is already in
+        flight (the skip contract: the cadence hit is simply lost and
+        the next boundary tries again)."""
+        with self._lock:
+            if self._stop:
+                return False
+            if self._job is not None or not self._idle.is_set():
+                self.skips += 1
+                now = time.time()
+                print(
+                    f"# checkpoint: async save of step {job.snapshot.step}"
+                    f" skipped — previous save still in flight "
+                    f"({self.skips} skip(s) so far)",
+                    file=sys.stderr,
+                )
+                self._record(
+                    job, "primary", "skipped",
+                    queued_ts=job.queued_ts, start=now, end=now,
+                )
+                return False
+            self._job = job
+            self._idle.clear()
+            self._wake.set()
+        return True
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no save is in flight. True = idle."""
+        return self._idle.wait(timeout)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain and stop the thread (idempotent)."""
+        self.drain(timeout)
+        with self._lock:
+            self._stop = True
+            self._wake.set()
+        self._thread.join(timeout)
+
+    # -------------------------------------------------------------- thread
+    def _run(self):
+        while True:
+            self._wake.wait()
+            with self._lock:
+                if self._stop:
+                    return
+                job, self._job = self._job, None
+                self._wake.clear()
+            if job is None:
+                continue
+            try:
+                self._save(job)
+            except BaseException as e:  # noqa: BLE001 — the writer
+                # thread never dies: an unforeseen failure is a counted
+                # failure, training (and the next cadence) continues
+                self.failures += 1
+                self.last_error = e
+                print(
+                    f"# checkpoint: async save of step {job.snapshot.step}"
+                    f" failed ({type(e).__name__}: {e})",
+                    file=sys.stderr,
+                )
+            finally:
+                self._idle.set()
+
+    def _save(self, job: SaveJob) -> None:
+        step = job.snapshot.step
+        t0 = time.perf_counter()
+        t0_wall = time.time()
+        flat = None
+        primary_ok = False
+        if not self.degraded:
+            try:
+                if job.fmt == "orbax":
+                    save_orbax(
+                        job.ckpt_dir, job.snapshot.state,
+                        data_state=job.data_state,
+                        publication=job.publication,
+                    )
+                else:
+                    flat = job.snapshot.materialize()
+                    write_flat(
+                        job.ckpt_dir, flat, step,
+                        data_state=job.data_state,
+                        publication=job.publication,
+                        tier="primary",
+                    )
+                primary_ok = True
+            except OSError as e:
+                with self._lock:
+                    # the fit thread reads `degraded` (health surfacing)
+                    self.degraded = True
+                self.failures += 1
+                self.last_error = e
+                print(
+                    f"# checkpoint: primary tier write failed at step "
+                    f"{step} ({type(e).__name__}: {e}); degrading to "
+                    "replica-only saves"
+                    + ("" if job.replica_dir else
+                       " — NO replica dir is configured: checkpointing "
+                       "is now best-effort only"),
+                    file=sys.stderr,
+                )
+                self._record(job, "primary", "failed",
+                             queued_ts=job.queued_ts, start=t0_wall,
+                             end=time.time())
+            except Exception as e:  # noqa: BLE001 — a non-IO primary
+                # failure (serialization bug, digest machinery) still
+                # tries the replica for THIS save, without latching
+                self.failures += 1
+                self.last_error = e
+                print(
+                    f"# checkpoint: primary save of step {step} failed "
+                    f"({type(e).__name__}: {e}); trying the replica tier",
+                    file=sys.stderr,
+                )
+                self._record(job, "primary", "failed",
+                             queued_ts=job.queued_ts, start=t0_wall,
+                             end=time.time())
+        if primary_ok:
+            self.saves += 1
+            self.last_step["primary"] = step
+            self._record(job, "primary", "committed",
+                         queued_ts=job.queued_ts, start=t0_wall,
+                         end=time.time())
+            self._span(job, t0_wall, time.perf_counter() - t0, step)
+            prune_checkpoints(job.ckpt_dir, job.keep, fmt=job.fmt)
+            if job.replica_dir:
+                m0, m0_wall = time.perf_counter(), time.time()
+                try:
+                    mirror_step(job.ckpt_dir, job.replica_dir, step,
+                                fmt=job.fmt)
+                    prune_checkpoints(job.replica_dir, job.keep_replica,
+                                      fmt=job.fmt)
+                    self.saves += 1
+                    self.last_step["replica"] = step
+                    self._record(job, "replica", "committed",
+                                 queued_ts=job.queued_ts, start=m0_wall,
+                                 end=time.time())
+                    self._span(job, m0_wall, time.perf_counter() - m0, step)
+                except Exception as e:  # noqa: BLE001 — a replica-tier
+                    # failure never harms the primary commit
+                    self.failures += 1
+                    self.last_error = e
+                    print(
+                        f"# checkpoint: replica mirror of step {step} "
+                        f"failed ({type(e).__name__}: {e}); the primary "
+                        "commit stands",
+                        file=sys.stderr,
+                    )
+                    self._record(job, "replica", "failed",
+                                 queued_ts=job.queued_ts, start=m0_wall,
+                                 end=time.time())
+        elif job.replica_dir:
+            # degraded (or the primary just failed): the FULL save —
+            # not a mirror, there is no primary copy — into the replica
+            w0, w0_wall = time.perf_counter(), time.time()
+            try:
+                if job.fmt == "orbax":
+                    save_orbax(job.replica_dir, job.snapshot.state,
+                               data_state=job.data_state,
+                               publication=job.publication)
+                else:
+                    if flat is None:
+                        flat = job.snapshot.materialize()
+                    write_flat(job.replica_dir, flat, step,
+                               data_state=job.data_state,
+                               publication=job.publication,
+                               tier="replica")
+                prune_checkpoints(job.replica_dir, job.keep_replica,
+                                  fmt=job.fmt)
+                self.saves += 1
+                self.last_step["replica"] = step
+                self._record(job, "replica", "committed",
+                             queued_ts=job.queued_ts, start=w0_wall,
+                             end=time.time())
+                self._span(job, w0_wall, time.perf_counter() - w0, step)
+            except Exception as e:  # noqa: BLE001 — both tiers failed:
+                # counted, logged, training still lives
+                self.failures += 1
+                self.last_error = e
+                print(
+                    f"# checkpoint: replica-tier save of step {step} "
+                    f"failed too ({type(e).__name__}: {e}); step not "
+                    "checkpointed",
+                    file=sys.stderr,
+                )
+                self._record(job, "replica", "failed",
+                             queued_ts=job.queued_ts, start=w0_wall,
+                             end=time.time())
+
+    # ------------------------------------------------------------ telemetry
+    def _record(self, job, tier, event, queued_ts, start, end):
+        sink = self._sink
+        if sink is None or not getattr(sink, "enabled", False):
+            return
+        # keys stay in lockstep with docs/OBSERVABILITY.md "Checkpoint
+        # records" (XF501-parsed) and metrics_report.CKPT_KEYS; the
+        # replica's queue_ms includes the primary write it mirrors
+        sink.log({
+            "kind": "ckpt",
+            "step": int(job.snapshot.step),
+            "tier": tier,
+            "event": event,
+            "queued_ts": round(float(queued_ts), 6),
+            "committed_ts": round(float(end), 6),
+            "queue_ms": round(max(start - queued_ts, 0.0) * 1000.0, 3),
+            "write_ms": round(max(end - start, 0.0) * 1000.0, 3),
+            "bytes": int(job.snapshot.nbytes),
+            "skips": int(self.skips),
+            "degraded": bool(self.degraded),
+        })
+
+    def _span(self, job, t0_wall, dur_s, step):
+        sink = self._sink
+        if (not self._ckpt_spans or sink is None
+                or not getattr(sink, "enabled", False)):
+            return
+        from xflow_tpu.tracing import emit_op_span
+
+        emit_op_span(sink, "checkpoint_save", t0_wall, dur_s,
+                     step=int(step), bytes=int(job.snapshot.nbytes))
 
 
 def export_sparse_array(w: np.ndarray, out_path: str) -> int:

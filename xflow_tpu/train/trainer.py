@@ -128,6 +128,8 @@ class Trainer:
             stamp={"rank": self._stamp_rank, "run_id": self.run_id},
             max_bytes=cfg.train.metrics_max_bytes,
         )
+        # lazily-started background checkpoint writer (train.ckpt_async)
+        self._ckpt_writer = None
         # compile accounting (train.compile_metrics, docs/OBSERVABILITY.md
         # "Compile accounting"): explicit timed .lower().compile() per
         # program with XLA cost/memory analysis; recompiles counted
@@ -930,6 +932,13 @@ class Trainer:
         try:
             return self._fit(train_path)
         finally:
+            # drain + stop the async checkpoint writer BEFORE the
+            # metrics sink closes: its final kind="ckpt" records must
+            # land, and fit() returning implies the last submitted save
+            # is durable (or its failure logged)
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.close()
+                self._ckpt_writer = None
             # release the metrics/heartbeat handles even on abnormal
             # exit; a later log() on this Trainer transparently reopens
             # in append mode
@@ -1503,7 +1512,7 @@ class Trainer:
                     # the bad updates were discarded on device, so the
                     # live state IS the last good state — commit it
                     # before aborting, like the preemption path
-                    self.save_checkpoint()
+                    self.save_checkpoint(wait=True)
                 raise NonFiniteHalt(
                     f"non-finite guard aborted at step {res.steps}: "
                     f"{res.bad_steps} bad step(s), {bad_run} consecutive "
@@ -1619,7 +1628,8 @@ class Trainer:
         self.metrics.log(final_rec)
         self.heartbeat.append({"event": "final", "step": res.steps})
         if cfg.train.checkpoint_dir:
-            self.save_checkpoint()
+            # the run's terminal state must be durable when fit returns
+            self.save_checkpoint(wait=True)
         return res
 
     # ---------------------------------------------------------- streaming fit
@@ -1779,8 +1789,11 @@ class Trainer:
                         self.heartbeat.append(
                             {"step": res.steps, "event": "checkpoint"}
                         )
-                        pub_seq += 1
-                        self._publish_checkpoint(newest, pub_seq)
+                        # the seq number is consumed only when the
+                        # publication landed (an async skip retries at
+                        # the next cadence with the SAME next seq)
+                        if self._publish_checkpoint(newest, pub_seq + 1):
+                            pub_seq += 1
                         self.heartbeat.append({"step": res.steps})
                         hang.tick()  # a slow publish is progress
                         if (
@@ -1843,7 +1856,7 @@ class Trainer:
                     }
                 )
                 if cfg.train.checkpoint_dir:
-                    self.save_checkpoint()
+                    self.save_checkpoint(wait=True)
                 raise NonFiniteHalt(
                     f"non-finite guard aborted at step {res.steps}: "
                     f"{res.bad_steps} bad step(s), {bad_run} consecutive"
@@ -1896,13 +1909,16 @@ class Trainer:
             # is on: the stream's last rows must become servable even
             # when the idle timeout lands mid-cadence
             if publish_every and newest is not None:
-                pub_seq += 1
-                self._publish_checkpoint(newest, pub_seq)
+                # wait=True drains any in-flight save first, so the
+                # final publication is never skipped
+                if self._publish_checkpoint(newest, pub_seq + 1, wait=True):
+                    pub_seq += 1
             else:
-                self.save_checkpoint()
+                self.save_checkpoint(wait=True)
         return res
 
-    def _publish_checkpoint(self, newest: tuple, seq: int) -> None:
+    def _publish_checkpoint(self, newest: tuple, seq: int,
+                            wait: bool = False) -> bool:
         """One in-run checkpoint PUBLICATION (docs/SERVING.md
         "Freshness"): a normal committed save plus the publication.json
         sidecar binding this step to the newest ingest trace whose rows
@@ -1911,7 +1927,11 @@ class Trainer:
         link freshness_report follows across the train/serve boundary.
         The sidecar lands before the COMMITTED marker (checkpoint.save),
         so a watcher never sees a committed step whose publication is
-        still in flight."""
+        still in flight. Under train.ckpt_async the save may be SKIPPED
+        (previous save still in flight) — then no publication happened:
+        no record, no span, the seq number is not consumed, and the
+        caller retries at the next cadence. Returns whether the
+        publication was accepted."""
         from xflow_tpu.tracing import emit_linked_span, new_id
 
         trace, ingest_ts, consumed_ts = newest
@@ -1926,7 +1946,8 @@ class Trainer:
             "consumed_ts": round(float(consumed_ts), 6),
             "published_ts": round(t0_wall, 6),
         }
-        self.save_checkpoint(publication=pub)
+        if not self.save_checkpoint(publication=pub, wait=wait):
+            return False
         if self.metrics.enabled:
             self.metrics.log(
                 {
@@ -1946,6 +1967,7 @@ class Trainer:
                 time.perf_counter() - t0,
                 trace=trace, span=pub["span"], step=step, seq=int(seq),
             )
+        return True
 
     # ------------------------------------------------------------------- eval
     def _local_pctrs(self, p_dev) -> np.ndarray:
@@ -2269,11 +2291,79 @@ class Trainer:
             )),
         )
 
-    def save_checkpoint(self, publication: Optional[dict] = None) -> None:
+    def _ckpt_async_on(self) -> bool:
+        """train.ckpt_async, gated to single-process runs: _flatten's
+        multihost gather is a collective no side thread may run. A
+        multi-process run that asked for async falls back to synchronous
+        saves with a one-time warning."""
+        if not self.cfg.train.ckpt_async:
+            return False
+        if jax.process_count() > 1:
+            if not getattr(self, "_ckpt_async_warned", False):
+                self._ckpt_async_warned = True
+                print(
+                    "# checkpoint: train.ckpt_async is single-process "
+                    "only (host-gather collectives cannot run on a side "
+                    "thread); falling back to synchronous saves",
+                    file=sys.stderr,
+                )
+            return False
+        return True
+
+    def _ensure_ckpt_writer(self):
+        from xflow_tpu.train import checkpoint as ckpt
+
+        if self._ckpt_writer is None:
+            self._ckpt_writer = ckpt.AsyncCheckpointWriter(
+                sink=self.metrics, ckpt_spans=self.cfg.train.ckpt_spans,
+            )
+        return self._ckpt_writer
+
+    def save_checkpoint(self, publication: Optional[dict] = None,
+                        wait: bool = False) -> bool:
+        """Checkpoint the current state. Synchronous by default; with
+        train.ckpt_async the fit loop only snapshots (device arrays are
+        pinned + D2H transfers started, data_state captured HERE — its
+        allgather is a collective) and the background writer owns the
+        disk. Returns False only when an async submit was skipped
+        because a save is still in flight; `wait=True` forces the save
+        to be on disk when this returns (halt/signal/end-of-fit paths)."""
         from xflow_tpu.train import checkpoint as ckpt
 
         t0_wall, t0 = time.time(), time.perf_counter()
         data_state = self._data_state_record()
+        if self._ckpt_async_on():
+            w = self._ensure_ckpt_writer()
+            if wait:
+                # a final save must not be skippable: drain whatever is
+                # in flight first, then the submit always lands. Re-stamp
+                # the queue instant AFTER the drain — queued_ts is this
+                # save's cadence instant, and the --check interval gate
+                # (at most one save in flight) reads it against the
+                # previous save's committed_ts
+                w.drain()
+                t0_wall = time.time()
+            job = ckpt.SaveJob(
+                snapshot=ckpt.SaveSnapshot(
+                    self.state, self._logical_widths()
+                ),
+                ckpt_dir=self.cfg.train.checkpoint_dir,
+                fmt=self.cfg.train.checkpoint_format,
+                replica_dir=self.cfg.train.ckpt_replica_dir,
+                keep=self.cfg.train.keep_checkpoints,
+                keep_replica=self.cfg.train.keep_replica_checkpoints,
+                data_state=data_state,
+                publication=publication,
+                queued_ts=t0_wall,
+            )
+            ok = w.submit(job)
+            if wait:
+                w.drain()
+            return ok
+        if self._ckpt_writer is not None:
+            # a mode flip (or the final synchronous paths of an async
+            # run) must not interleave with an in-flight async write
+            self._ckpt_writer.drain()
         if self.cfg.train.checkpoint_format == "orbax":
             # orbax stores the device arrays in their NATIVE (possibly
             # packed) layout, shard-parallel; npz stores the LOGICAL
@@ -2299,6 +2389,30 @@ class Trainer:
             self.cfg.train.keep_checkpoints,
             fmt=self.cfg.train.checkpoint_format,
         )
+        if self.cfg.train.ckpt_replica_dir and jax.process_index() == 0:
+            # synchronous runs mirror inline (same commit contract, no
+            # writer thread); a replica failure never harms the primary
+            try:
+                ckpt.mirror_step(
+                    self.cfg.train.checkpoint_dir,
+                    self.cfg.train.ckpt_replica_dir,
+                    int(self.state.step),
+                    fmt=self.cfg.train.checkpoint_format,
+                )
+                ckpt.prune_checkpoints(
+                    self.cfg.train.ckpt_replica_dir,
+                    self.cfg.train.keep_replica_checkpoints,
+                    fmt=self.cfg.train.checkpoint_format,
+                )
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"# checkpoint: replica mirror of step "
+                    f"{int(self.state.step)} failed "
+                    f"({type(e).__name__}: {e}); the primary commit "
+                    "stands",
+                    file=sys.stderr,
+                )
+        return True
 
     def _logical_widths(self) -> dict:
         """{table: K} logical row widths, for unpacking packed storage."""
@@ -2335,18 +2449,24 @@ class Trainer:
         # only when checkpoints exist and NONE loads.
         t0_wall, t0 = time.time(), time.perf_counter()
         try:
-            self.state, step = ckpt.restore_any(
+            # the walk covers BOTH tiers: a primary step that is
+            # missing or digest-poisoned restores from the replica
+            # mirror (train.ckpt_replica_dir) before falling back to
+            # an older step
+            self.state, step, src = ckpt.restore_tiered(
                 cdir, self.state, fmt=fmt,
                 verify=self.cfg.train.checkpoint_verify,
+                replica_dir=self.cfg.train.ckpt_replica_dir or None,
             )
         except FileNotFoundError:
             return False
         self._ckpt_span("checkpoint_restore", t0_wall, t0, int(step))
         # the data-stream position travels with the step that actually
         # restored (a walk-back must not pair step N-1's weights with
-        # step N's stream offset); missing/unreadable data_state
-        # downgrades to a fresh stream inside read_data_state
-        self._resume_data_state = ckpt.read_data_state(cdir, step, fmt=fmt)
+        # step N's stream offset) and from the TIER that restored it;
+        # missing/unreadable data_state downgrades to a fresh stream
+        # inside read_data_state
+        self._resume_data_state = ckpt.read_data_state(src, step, fmt=fmt)
         return True
 
 
